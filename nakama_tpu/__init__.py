@@ -1,0 +1,12 @@
+"""nakama-tpu: a TPU-native realtime game-server framework.
+
+Re-designed from scratch with the capabilities of the reference game server
+(Heroic Labs Nakama, surveyed in SURVEY.md): accounts and social auth, OCC
+object storage, friends/groups/chat, presence tracking + realtime messaging,
+authoritative multiplayer matches, parties, leaderboards/tournaments,
+notifications, an embedded Python scripting runtime, admin console API, and
+Prometheus metrics — with the per-interval matchmaker hot loop re-framed as a
+batched TPU kernel (JAX/XLA/Pallas) instead of a CPU index walk.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,331 @@
+"""Configuration tree: dataclasses + YAML files + reflected CLI flags.
+
+Capability parity with the reference config system (reference
+server/config.go:35-1073 and flags/ reflection flag-maker): every config key
+is a nested dataclass field, loadable from one or more YAML files (later
+files win) and overridable by ``--dotted.flag`` command-line arguments
+(flags win over files). ``check()`` returns a list of warnings the console
+surfaces, mirroring the reference's CheckConfig.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any
+
+import yaml
+
+
+@dataclass
+class LoggerConfig:
+    level: str = "info"
+    format: str = "json"  # json | text
+    stdout: bool = True
+    file: str = ""
+
+
+@dataclass
+class MetricsConfig:
+    reporting_freq_sec: int = 60
+    namespace: str = ""
+    prometheus_port: int = 0  # 0 = serve on console mux instead of own port
+
+
+@dataclass
+class SessionConfig:
+    encryption_key: str = "defaultencryptionkey"
+    token_expiry_sec: int = 60
+    refresh_encryption_key: str = "defaultrefreshencryptionkey"
+    refresh_token_expiry_sec: int = 3600
+    single_socket: bool = False
+    single_match: bool = False
+    single_party: bool = False
+    single_session: bool = False
+
+
+@dataclass
+class SocketConfig:
+    server_key: str = "defaultkey"
+    port: int = 7350
+    address: str = ""
+    max_message_size_bytes: int = 4096
+    max_request_size_bytes: int = 262_144
+    read_buffer_size_bytes: int = 4096
+    write_buffer_size_bytes: int = 4096
+    idle_timeout_ms: int = 60_000
+    ping_period_ms: int = 15_000
+    pong_wait_ms: int = 25_000
+    ping_backoff_threshold: int = 20
+    outgoing_queue_size: int = 64
+
+
+@dataclass
+class DatabaseConfig:
+    address: list[str] = field(default_factory=lambda: ["nakama.db"])
+    driver: str = "sqlite"  # sqlite today; asyncpg seam for postgres
+    conn_max_lifetime_ms: int = 3_600_000
+    max_open_conns: int = 100
+
+
+@dataclass
+class MatchmakerConfig:
+    """Reference defaults: server/config.go:971-989."""
+
+    max_tickets: int = 3
+    interval_sec: int = 15
+    max_intervals: int = 2
+    rev_precision: bool = False
+    rev_threshold: int = 1
+    # TPU-native knobs (no reference equivalent):
+    backend: str = "auto"  # auto | cpu | tpu
+    pool_capacity: int = 131_072
+    max_constraints: int = 16  # query constraint slots compiled per ticket
+    candidates_per_ticket: int = 64  # device top-K candidate width
+    numeric_fields: int = 24
+    string_fields: int = 16
+    max_party_size: int = 8
+
+
+@dataclass
+class MatchConfig:
+    """Queue sizes mirror reference server/config.go:893-902."""
+
+    input_queue_size: int = 128
+    call_queue_size: int = 128
+    signal_queue_size: int = 10
+    join_attempt_queue_size: int = 128
+    deferred_queue_size: int = 128
+    join_marker_deadline_ms: int = 15_000
+    max_empty_sec: int = 0
+    label_update_interval_ms: int = 1000
+
+
+@dataclass
+class TrackerConfig:
+    event_queue_size: int = 1024
+
+
+@dataclass
+class RuntimeConfig:
+    path: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    http_key: str = "defaulthttpkey"
+    event_queue_size: int = 65_536
+    event_queue_workers: int = 8
+
+
+@dataclass
+class ConsoleConfig:
+    port: int = 7351
+    address: str = ""
+    username: str = "admin"
+    password: str = "password"
+    signing_key: str = "defaultsigningkey"
+    max_message_size_bytes: int = 4_194_304
+    token_expiry_sec: int = 86_400
+
+
+@dataclass
+class LeaderboardConfig:
+    blacklist_rank_cache: list[str] = field(default_factory=list)
+    callback_queue_size: int = 65_536
+    callback_queue_workers: int = 8
+
+
+@dataclass
+class IAPConfig:
+    apple_shared_password: str = ""
+    google_client_email: str = ""
+    google_private_key: str = ""
+    huawei_client_id: str = ""
+    huawei_client_secret: str = ""
+    huawei_public_key: str = ""
+
+
+@dataclass
+class SocialConfig:
+    steam_app_id: int = 0
+    steam_publisher_key: str = ""
+    facebook_instant_app_secret: str = ""
+    apple_bundle_id: str = ""
+
+
+@dataclass
+class Config:
+    name: str = "nakama-tpu"
+    data_dir: str = "./data"
+    shutdown_grace_sec: int = 0
+    logger: LoggerConfig = field(default_factory=LoggerConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    session: SessionConfig = field(default_factory=SessionConfig)
+    socket: SocketConfig = field(default_factory=SocketConfig)
+    database: DatabaseConfig = field(default_factory=DatabaseConfig)
+    matchmaker: MatchmakerConfig = field(default_factory=MatchmakerConfig)
+    match: MatchConfig = field(default_factory=MatchConfig)
+    tracker: TrackerConfig = field(default_factory=TrackerConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    console: ConsoleConfig = field(default_factory=ConsoleConfig)
+    leaderboard: LeaderboardConfig = field(default_factory=LeaderboardConfig)
+    iap: IAPConfig = field(default_factory=IAPConfig)
+    social: SocialConfig = field(default_factory=SocialConfig)
+
+    @property
+    def node(self) -> str:
+        return self.name
+
+    def check(self) -> list[str]:
+        """Sanity-check the config; returns warnings (shown in console)."""
+        warnings: list[str] = []
+        if self.session.encryption_key == "defaultencryptionkey":
+            warnings.append("session.encryption_key is the insecure default")
+        if self.socket.server_key == "defaultkey":
+            warnings.append("socket.server_key is the insecure default")
+        if self.console.password == "password":
+            warnings.append("console.password is the insecure default")
+        if self.matchmaker.max_tickets < 1:
+            raise ValueError("matchmaker.max_tickets must be >= 1")
+        if self.matchmaker.interval_sec < 1:
+            raise ValueError("matchmaker.interval_sec must be >= 1")
+        if self.matchmaker.max_intervals < 1:
+            raise ValueError("matchmaker.max_intervals must be >= 1")
+        if self.socket.port == self.console.port:
+            raise ValueError("socket.port and console.port must differ")
+        return warnings
+
+
+def _set_dotted(obj: Any, dotted: str, raw: str) -> None:
+    parts = dotted.split(".")
+    try:
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        leaf = parts[-1]
+        current = getattr(obj, leaf)
+    except AttributeError as e:
+        raise ValueError(f"unknown config flag: --{dotted}") from e
+    if isinstance(current, bool):
+        value: Any = raw.lower() in ("1", "true", "yes", "on")
+    elif isinstance(current, int):
+        value = int(raw)
+    elif isinstance(current, float):
+        value = float(raw)
+    elif isinstance(current, list):
+        value = [x for x in raw.split(",") if x]
+    elif isinstance(current, dict):
+        value = dict(kv.split("=", 1) for kv in raw.split(",") if "=" in kv)
+    else:
+        value = raw
+    setattr(obj, leaf, value)
+
+
+def _merge_dict(cfg: Any, data: dict) -> None:
+    for key, value in data.items():
+        if not hasattr(cfg, key):
+            raise ValueError(f"unknown config key: {key}")
+        current = getattr(cfg, key)
+        if is_dataclass(current):
+            if value is None:
+                continue  # empty yaml section ("logger:") keeps defaults
+            if not isinstance(value, dict):
+                raise ValueError(
+                    f"config section {key!r} must be a mapping, got {type(value).__name__}"
+                )
+            _merge_dict(current, value)
+        else:
+            setattr(cfg, key, value)
+
+
+def load_config(
+    yaml_paths: list[str] | None = None, argv: list[str] | None = None
+) -> Config:
+    """Build a Config from YAML file(s) then CLI flags (flags win).
+
+    Flags are ``--section.key value`` or ``--section.key=value``, generated
+    by reflection over the dataclass tree the way the reference's flags/
+    package reflects over struct yaml tags.
+    """
+    cfg = Config()
+    for path in yaml_paths or []:
+        with open(path) as fh:
+            data = yaml.safe_load(fh) or {}
+        _merge_dict(cfg, data)
+
+    argv = list(argv or [])
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if not arg.startswith("--"):
+            raise ValueError(f"unexpected argument: {arg}")
+        body = arg[2:]
+        if "=" in body:
+            dotted, raw = body.split("=", 1)
+            i += 1
+        else:
+            dotted = body
+            if i + 1 >= len(argv):
+                raise ValueError(f"flag {arg} missing value")
+            raw = argv[i + 1]
+            i += 2
+        _set_dotted(cfg, dotted, raw)
+    return cfg
+
+
+def parse_args(argv: list[str]) -> Config:
+    """CLI entrypoint parsing: ``--config file.yml`` flags first, rest as overrides."""
+    yaml_paths: list[str] = []
+    rest: list[str] = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--config":
+            if i + 1 >= len(argv):
+                raise ValueError("flag --config missing value")
+            yaml_paths.append(argv[i + 1])
+            i += 2
+        elif argv[i].startswith("--config="):
+            yaml_paths.append(argv[i].split("=", 1)[1])
+            i += 1
+        else:
+            rest.append(argv[i])
+            i += 1
+    cfg = load_config(yaml_paths, rest)
+    if not cfg.name:
+        cfg.name = socket.gethostname()
+    return cfg
+
+
+def config_to_dict(cfg: Any, redact: bool = False) -> dict:
+    """Dump the config tree (console config view; redacts keys/passwords)."""
+    out: dict[str, Any] = {}
+    for f in fields(cfg):
+        value = getattr(cfg, f.name)
+        if is_dataclass(value):
+            out[f.name] = config_to_dict(value, redact=redact)
+        else:
+            if redact and any(
+                s in f.name for s in ("key", "password", "secret")
+            ):
+                value = "***" if value else ""
+            out[f.name] = value
+    return out
+
+
+__all__ = [
+    "Config",
+    "LoggerConfig",
+    "MetricsConfig",
+    "SessionConfig",
+    "SocketConfig",
+    "DatabaseConfig",
+    "MatchmakerConfig",
+    "MatchConfig",
+    "TrackerConfig",
+    "RuntimeConfig",
+    "ConsoleConfig",
+    "LeaderboardConfig",
+    "IAPConfig",
+    "SocialConfig",
+    "load_config",
+    "parse_args",
+    "config_to_dict",
+]

@@ -1,0 +1,115 @@
+"""Structured logging: JSON or text lines, per-subsystem child loggers.
+
+Parity with the reference's zap setup (reference server/logger.go:1-221):
+json/text formats, stdout and/or file sinks, level filtering, and cheap
+``with_fields`` child loggers carrying bound key-values.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any, TextIO
+
+from .config import LoggerConfig
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+class Logger:
+    """A leveled, structured logger with bound fields."""
+
+    def __init__(
+        self,
+        level: int = logging.INFO,
+        fmt: str = "json",
+        streams: list[TextIO] | None = None,
+        fields: dict[str, Any] | None = None,
+    ):
+        self._level = level
+        self._fmt = fmt
+        self._streams = streams if streams is not None else [sys.stdout]
+        self._fields = fields or {}
+
+    def with_fields(self, **fields: Any) -> "Logger":
+        merged = {**self._fields, **fields}
+        return Logger(self._level, self._fmt, self._streams, merged)
+
+    def _log(self, level: int, name: str, msg: str, kv: dict[str, Any]):
+        if level < self._level:
+            return
+        record = {
+            "level": name,
+            "ts": round(time.time(), 3),
+            "msg": msg,
+            **self._fields,
+            **kv,
+        }
+        if self._fmt == "json":
+            line = json.dumps(record, default=str)
+        else:
+            extras = " ".join(
+                f"{k}={v}" for k, v in record.items() if k not in ("msg",)
+            )
+            line = f"{msg} {extras}"
+        for stream in self._streams:
+            try:
+                stream.write(line + "\n")
+            except ValueError:  # closed file during shutdown
+                pass
+
+    def debug(self, msg: str, **kv: Any):
+        self._log(logging.DEBUG, "debug", msg, kv)
+
+    def info(self, msg: str, **kv: Any):
+        self._log(logging.INFO, "info", msg, kv)
+
+    def warn(self, msg: str, **kv: Any):
+        self._log(logging.WARNING, "warn", msg, kv)
+
+    warning = warn
+
+    def error(self, msg: str, **kv: Any):
+        self._log(logging.ERROR, "error", msg, kv)
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    def close(self):
+        """Flush and close any owned (file) streams; safe to call twice."""
+        for stream in self._streams:
+            if stream in (sys.stdout, sys.stderr):
+                continue
+            try:
+                stream.flush()
+                stream.close()
+            except ValueError:
+                pass
+
+
+def setup_logging(cfg: LoggerConfig) -> Logger:
+    streams: list[TextIO] = []
+    if cfg.stdout:
+        streams.append(sys.stdout)
+    if cfg.file:
+        # Line-buffered so a crash loses at most the in-flight line.
+        streams.append(open(cfg.file, "a", buffering=1))
+    return Logger(
+        level=_LEVELS.get(cfg.level.lower(), logging.INFO),
+        fmt=cfg.format,
+        streams=streams or [sys.stdout],
+    )
+
+
+def test_logger() -> Logger:
+    """Quiet logger for tests (mirrors reference loggerForTest)."""
+    return Logger(level=logging.ERROR, fmt="text", streams=[sys.stderr])

@@ -1,0 +1,158 @@
+"""Metrics facade over prometheus_client.
+
+Parity with the reference Metrics interface (reference server/metrics.go:33-68):
+API timers, realtime gauges (sessions/presences/matches), the matchmaker
+gauges + process timer (server/metrics.go:421-425 — our north-star
+observable), snapshot counters for the console status dashboard, and custom
+metrics exposed to the user runtime (CounterAdd/GaugeSet/TimerRecord).
+
+Each Metrics instance owns a private CollectorRegistry so tests and
+embedded servers never collide on the global default registry.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Metrics:
+    def __init__(self, namespace: str = ""):
+        self.registry = CollectorRegistry()
+        ns = namespace or "nakama"
+        self._ns = ns
+
+        def counter(name, doc, labels=()):
+            return Counter(name, doc, labels, namespace=ns, registry=self.registry)
+
+        def gauge(name, doc, labels=()):
+            return Gauge(name, doc, labels, namespace=ns, registry=self.registry)
+
+        def histo(name, doc, labels=()):
+            return Histogram(
+                name, doc, labels, namespace=ns, registry=self.registry,
+                buckets=_LATENCY_BUCKETS,
+            )
+
+        # API layer.
+        self.api_time = histo("api_time_sec", "Per-RPC latency", ("rpc",))
+        self.api_count = counter("api_count", "Per-RPC calls", ("rpc", "code"))
+        self.api_recv_bytes = counter("api_recv_bytes", "Request bytes", ("rpc",))
+        self.api_sent_bytes = counter("api_sent_bytes", "Response bytes", ("rpc",))
+
+        # Realtime gauges.
+        self.sessions = gauge("sessions", "Connected sessions")
+        self.presences = gauge("presences", "Tracked presences")
+        self.matches = gauge("matches_authoritative", "Live authoritative matches")
+        self.parties = gauge("parties", "Live parties")
+
+        # Matchmaker (north star).
+        self.mm_tickets = gauge("matchmaker_tickets", "Tickets in the pool")
+        self.mm_active_tickets = gauge(
+            "matchmaker_active_tickets", "Actively-querying tickets"
+        )
+        self.mm_process_time = histo(
+            "matchmaker_process_time_sec", "Per-interval Process() latency"
+        )
+        self.mm_matched = counter("matchmaker_matched", "Tickets matched")
+        self.mm_device_time = histo(
+            "matchmaker_device_time_sec", "TPU kernel time inside Process()"
+        )
+
+        # Message routing / presence events.
+        self.outgoing_dropped = counter(
+            "socket_outgoing_dropped", "Messages dropped on full session queues"
+        )
+        self.presence_event_time = histo(
+            "presence_event_sec", "Tracker event queue latency"
+        )
+
+        # Custom metrics surface for the user runtime. Keyed by kind+name;
+        # names are kind-prefixed in the registry so a counter and a gauge
+        # sharing a user name never collide, and a label-set change on an
+        # existing name is a loud error instead of a Duplicated-timeseries
+        # crash from inside prometheus_client.
+        self._custom: dict[tuple[str, str], tuple[Any, tuple[str, ...]]] = {}
+
+        self._snapshot_start = time.time()
+
+    # -- custom metrics (runtime-facing, reference runtime_go_nakama.go
+    #    MetricsCounterAdd / MetricsGaugeSet / MetricsTimerRecord) --
+
+    def _custom_metric(self, kind: str, cls, name: str, labels: dict):
+        labelnames = tuple(sorted(labels))
+        entry = self._custom.get((kind, name))
+        if entry is None:
+            kwargs = {"namespace": self._ns, "registry": self.registry}
+            if cls is Histogram:
+                kwargs["buckets"] = _LATENCY_BUCKETS
+            metric = cls(
+                f"custom_{kind}_{name}", f"custom {kind}", labelnames, **kwargs
+            )
+            self._custom[(kind, name)] = (metric, labelnames)
+        else:
+            metric, registered = entry
+            if registered != labelnames:
+                raise ValueError(
+                    f"custom {kind} {name!r} registered with labels "
+                    f"{registered}, called with {labelnames}"
+                )
+        return metric.labels(**labels) if labels else metric
+
+    def counter_add(self, name: str, value: float = 1.0, **labels: str):
+        self._custom_metric("counter", Counter, name, labels).inc(value)
+
+    def gauge_set(self, name: str, value: float, **labels: str):
+        self._custom_metric("gauge", Gauge, name, labels).set(value)
+
+    def timer_record(self, name: str, seconds: float, **labels: str):
+        self._custom_metric("timer", Histogram, name, labels).observe(seconds)
+
+    # -- scrape / snapshot --
+
+    def scrape(self) -> bytes:
+        return generate_latest(self.registry)
+
+    def snapshot(self) -> dict:
+        """Console status dashboard sample (reference status_handler.go:64)."""
+        out: dict[str, float] = {}
+        for metric in self.registry.collect():
+            for sample in metric.samples:
+                if sample.name.endswith(("_created",)):
+                    continue
+                label = ",".join(f"{k}={v}" for k, v in sorted(sample.labels.items()))
+                key = sample.name + ("{" + label + "}" if label else "")
+                out[key] = sample.value
+        return out
+
+
+class _Timed:
+    __slots__ = ("_h", "_t0")
+
+    def __init__(self, h: Histogram):
+        self._h = h
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._h.observe(time.perf_counter() - self._t0)
+        return False
+
+
+def timed(histogram: Histogram) -> Iterator[None]:
+    return _Timed(histogram)

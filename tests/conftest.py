@@ -1,0 +1,36 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so sharding/collective tests run
+without TPU hardware (mirrors the reference's in-memory test style,
+reference server/match_common_test.go:34-81, but adds the multi-device tier
+the reference lacks — see SURVEY.md §4).
+
+Must set XLA_FLAGS before jax initialises, hence this lives at the very top
+of conftest and tests must not import jax before pytest collects us.
+"""
+
+import asyncio
+import inspect
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests on a fresh event loop (no pytest-asyncio here)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

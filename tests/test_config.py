@@ -1,0 +1,84 @@
+import pytest
+
+from nakama_tpu.config import Config, config_to_dict, load_config, parse_args
+
+
+def test_defaults_match_reference_envelope():
+    cfg = Config()
+    # Reference defaults: server/config.go:971-989.
+    assert cfg.matchmaker.max_tickets == 3
+    assert cfg.matchmaker.interval_sec == 15
+    assert cfg.matchmaker.max_intervals == 2
+    assert cfg.matchmaker.rev_precision is False
+    assert cfg.match.input_queue_size == 128
+    assert cfg.match.signal_queue_size == 10
+
+
+def test_yaml_then_flags_precedence(tmp_path):
+    p = tmp_path / "c.yml"
+    p.write_text(
+        "name: testnode\nmatchmaker:\n  interval_sec: 5\nsocket:\n  port: 8350\n"
+    )
+    cfg = load_config([str(p)], ["--matchmaker.interval_sec", "7", "--socket.server_key=k1"])
+    assert cfg.name == "testnode"
+    assert cfg.matchmaker.interval_sec == 7  # flag wins over file
+    assert cfg.socket.port == 8350
+    assert cfg.socket.server_key == "k1"
+
+
+def test_unknown_yaml_key_rejected(tmp_path):
+    p = tmp_path / "c.yml"
+    p.write_text("nonsense: 1\n")
+    with pytest.raises(ValueError):
+        load_config([str(p)])
+
+
+def test_empty_yaml_section_keeps_defaults(tmp_path):
+    p = tmp_path / "c.yml"
+    p.write_text("logger:\nname: x\n")
+    cfg = load_config([str(p)])
+    assert cfg.logger.level == "info"
+    p.write_text("logger: 5\n")
+    with pytest.raises(ValueError):
+        load_config([str(p)])
+
+
+def test_unknown_flag_is_value_error():
+    with pytest.raises(ValueError, match="unknown config flag"):
+        load_config(None, ["--sokcet.port", "1"])
+    with pytest.raises(ValueError, match="missing value"):
+        from nakama_tpu.config import parse_args as pa
+
+        pa(["--config"])
+
+
+def test_bool_and_list_flags():
+    cfg = load_config(None, [
+        "--matchmaker.rev_precision", "true",
+        "--database.address", "a.db,b.db",
+    ])
+    assert cfg.matchmaker.rev_precision is True
+    assert cfg.database.address == ["a.db", "b.db"]
+
+
+def test_check_warnings_and_errors():
+    cfg = Config()
+    warnings = cfg.check()
+    assert any("server_key" in w for w in warnings)
+    cfg.console.port = cfg.socket.port
+    with pytest.raises(ValueError):
+        cfg.check()
+
+
+def test_parse_args_config_flag(tmp_path):
+    p = tmp_path / "c.yml"
+    p.write_text("name: n1\n")
+    cfg = parse_args(["--config", str(p), "--console.port", "9999"])
+    assert cfg.name == "n1"
+    assert cfg.console.port == 9999
+
+
+def test_redacted_dump():
+    d = config_to_dict(Config(), redact=True)
+    assert d["session"]["encryption_key"] == "***"
+    assert d["socket"]["port"] == 7350

@@ -1,0 +1,52 @@
+import io
+import json
+import logging
+
+from nakama_tpu.logger import Logger
+from nakama_tpu.metrics import Metrics, timed
+
+
+def test_json_logging_with_fields():
+    buf = io.StringIO()
+    log = Logger(level=logging.INFO, fmt="json", streams=[buf])
+    child = log.with_fields(subsystem="matchmaker")
+    child.info("hello", tickets=5)
+    child.debug("dropped")  # below level
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["msg"] == "hello"
+    assert lines[0]["subsystem"] == "matchmaker"
+    assert lines[0]["tickets"] == 5
+
+
+def test_metrics_isolated_registries_and_scrape():
+    m1, m2 = Metrics(), Metrics()
+    m1.sessions.inc()
+    m1.mm_tickets.set(42)
+    with timed(m1.mm_process_time):
+        pass
+    text = m1.scrape().decode()
+    assert "nakama_matchmaker_tickets 42.0" in text
+    assert "nakama_sessions 1.0" in text
+    assert "nakama_sessions 1.0" not in m2.scrape().decode()
+
+
+def test_custom_metrics_surface():
+    m = Metrics()
+    m.counter_add("my_events", 3, kind="a")
+    m.gauge_set("my_level", 7.5)
+    m.timer_record("my_op", 0.01)
+    snap = m.snapshot()
+    assert snap.get("nakama_custom_counter_my_events_total{kind=a}") == 3.0
+    assert snap.get("nakama_custom_gauge_my_level") == 7.5
+
+
+def test_custom_metrics_name_reuse():
+    import pytest
+
+    m = Metrics()
+    m.counter_add("x", kind="a")
+    m.gauge_set("x", 1.0)  # same user name, different kind: allowed
+    m.counter_add("x", 2, kind="a")
+    with pytest.raises(ValueError):
+        m.counter_add("x")  # label-set change on same counter: loud error
