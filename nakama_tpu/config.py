@@ -195,6 +195,60 @@ class Config:
         return warnings
 
 
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+def _coerce(current: Any, value: Any, where: str) -> Any:
+    """Coerce `value` (a flag string or a YAML scalar) to the type of the
+    field's current/default value; reject mismatches loudly."""
+    if isinstance(current, bool):
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            low = value.lower()
+            if low in _TRUE:
+                return True
+            if low in _FALSE:
+                return False
+        raise ValueError(f"{where}: expected a boolean, got {value!r}")
+    if isinstance(current, int) and not isinstance(current, bool):
+        if isinstance(value, int) and not isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            try:
+                return int(value)
+            except ValueError:
+                pass
+        raise ValueError(f"{where}: expected an integer, got {value!r}")
+    if isinstance(current, float):
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            return float(value)
+        if isinstance(value, str):
+            try:
+                return float(value)
+            except ValueError:
+                pass
+        raise ValueError(f"{where}: expected a number, got {value!r}")
+    if isinstance(current, list):
+        if isinstance(value, list):
+            return value
+        if isinstance(value, str):
+            return [x for x in value.split(",") if x]
+        raise ValueError(f"{where}: expected a list, got {value!r}")
+    if isinstance(current, dict):
+        if isinstance(value, dict):
+            return value
+        if isinstance(value, str):
+            return dict(
+                kv.split("=", 1) for kv in value.split(",") if "=" in kv
+            )
+        raise ValueError(f"{where}: expected a mapping, got {value!r}")
+    if isinstance(value, str):
+        return value
+    raise ValueError(f"{where}: expected a string, got {value!r}")
+
+
 def _set_dotted(obj: Any, dotted: str, raw: str) -> None:
     parts = dotted.split(".")
     try:
@@ -202,24 +256,18 @@ def _set_dotted(obj: Any, dotted: str, raw: str) -> None:
             obj = getattr(obj, p)
         leaf = parts[-1]
         current = getattr(obj, leaf)
+        if leaf not in {f.name for f in fields(obj)}:
+            raise AttributeError(leaf)  # property/method, not a config field
+        setattr(obj, leaf, _coerce(current, raw, f"--{dotted}"))
     except AttributeError as e:
         raise ValueError(f"unknown config flag: --{dotted}") from e
-    if isinstance(current, bool):
-        value: Any = raw.lower() in ("1", "true", "yes", "on")
-    elif isinstance(current, int):
-        value = int(raw)
-    elif isinstance(current, float):
-        value = float(raw)
-    elif isinstance(current, list):
-        value = [x for x in raw.split(",") if x]
-    elif isinstance(current, dict):
-        value = dict(kv.split("=", 1) for kv in raw.split(",") if "=" in kv)
-    else:
-        value = raw
-    setattr(obj, leaf, value)
 
 
-def _merge_dict(cfg: Any, data: dict) -> None:
+def _merge_dict(cfg: Any, data: Any) -> None:
+    if not isinstance(data, dict):
+        raise ValueError(
+            f"config document must be a mapping, got {type(data).__name__}"
+        )
     for key, value in data.items():
         if not hasattr(cfg, key):
             raise ValueError(f"unknown config key: {key}")
@@ -233,7 +281,7 @@ def _merge_dict(cfg: Any, data: dict) -> None:
                 )
             _merge_dict(current, value)
         else:
-            setattr(cfg, key, value)
+            setattr(cfg, key, _coerce(current, value, key))
 
 
 def load_config(

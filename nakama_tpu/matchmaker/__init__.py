@@ -1,0 +1,52 @@
+"""TPU-first matchmaker.
+
+The reference's per-interval CPU loop over an inverted ticket index
+(reference server/matchmaker.go, server/matchmaker_process.go) re-designed
+as: query→constraint-slot compilation, a device-resident ticket pool buffer,
+a blockwise pairwise-eligibility + top-K candidate kernel on TPU, and a
+native C++ greedy assembler for the sequential combo formation.
+
+Layers:
+- `query`    — query-string parser + host evaluator (shared front end)
+- `types`    — ticket/entry/extract data model
+- `process`  — CPU oracle process loop (exact reference semantics)
+- `local`    — LocalMatchmaker bookkeeping + interval driver
+- `compile`  — query/properties → constraint-slot + feature tensors
+- `device`   — device pool buffer + the TPU kernels
+- `tpu`      — the TPU ProcessBackend (kernel + native assembler)
+"""
+
+from .local import (
+    CpuBackend,
+    ErrDuplicateSession,
+    ErrNotAvailable,
+    ErrQueryInvalid,
+    ErrTooManyTickets,
+    LocalMatchmaker,
+    MatchmakerError,
+)
+from .query import QueryError, evaluate, matches, parse_query
+from .types import (
+    MatchmakerEntry,
+    MatchmakerExtract,
+    MatchmakerPresence,
+    MatchmakerTicket,
+)
+
+__all__ = [
+    "LocalMatchmaker",
+    "CpuBackend",
+    "MatchmakerError",
+    "ErrTooManyTickets",
+    "ErrQueryInvalid",
+    "ErrDuplicateSession",
+    "ErrNotAvailable",
+    "QueryError",
+    "parse_query",
+    "evaluate",
+    "matches",
+    "MatchmakerEntry",
+    "MatchmakerExtract",
+    "MatchmakerPresence",
+    "MatchmakerTicket",
+]

@@ -1,0 +1,394 @@
+"""LocalMatchmaker: ticket pool bookkeeping + interval processing.
+
+Capability parity with the reference Matchmaker interface and LocalMatchmaker
+(reference server/matchmaker.go:169-1068): add/remove/extract/insert with
+per-session and per-party MaxTickets enforcement, pause/resume/stop, and a
+per-interval `process()` that forms matches and reports them to a callback.
+
+The process backend is pluggable: the CPU oracle (`process.py`) or the TPU
+batch backend (`tpu.py`). Custom (runtime-override) processing always runs
+the host path since it enumerates combinatorial candidates for user code.
+
+Async production use: `start()` spawns an asyncio interval task; tests call
+`process()` directly with the ticker off, mirroring the reference's
+NewLocalBenchMatchmaker (server/matchmaker_test.go:1697).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Callable, Protocol
+
+from ..config import MatchmakerConfig
+from ..logger import Logger
+from ..metrics import Metrics
+from .process import process_custom, process_default
+from .query import QueryError, parse_query
+from .types import (
+    MatchmakerEntry,
+    MatchmakerExtract,
+    MatchmakerPresence,
+    MatchmakerTicket,
+)
+
+
+class MatchmakerError(Exception):
+    pass
+
+
+class ErrTooManyTickets(MatchmakerError):
+    pass
+
+
+class ErrQueryInvalid(MatchmakerError):
+    pass
+
+
+class ErrDuplicateSession(MatchmakerError):
+    pass
+
+
+class ErrNotAvailable(MatchmakerError):
+    pass
+
+
+MatchedCallback = Callable[[list[list[MatchmakerEntry]]], None]
+OverrideFn = Callable[
+    [list[list[MatchmakerEntry]]], list[list[MatchmakerEntry]]
+]
+
+
+class ProcessBackend(Protocol):
+    def process(
+        self,
+        actives: list[MatchmakerTicket],
+        pool: dict[str, MatchmakerTicket],
+        *,
+        max_intervals: int,
+        rev_precision: bool,
+    ) -> tuple[list[list[MatchmakerEntry]], list[str]]: ...
+
+
+class CpuBackend:
+    """The oracle backend — exact reference semantics on host."""
+
+    def process(self, actives, pool, *, max_intervals, rev_precision):
+        return process_default(
+            actives,
+            pool,
+            max_intervals=max_intervals,
+            rev_precision=rev_precision,
+        )
+
+
+class LocalMatchmaker:
+    def __init__(
+        self,
+        logger: Logger,
+        config: MatchmakerConfig,
+        metrics: Metrics | None = None,
+        node: str = "local",
+        backend: ProcessBackend | None = None,
+        on_matched: MatchedCallback | None = None,
+    ):
+        self.logger = logger.with_fields(subsystem="matchmaker")
+        self.config = config
+        self.metrics = metrics
+        self.node = node
+        self.backend = backend or CpuBackend()
+        self.on_matched = on_matched
+        self.override_fn: OverrideFn | None = None
+
+        self.tickets: dict[str, MatchmakerTicket] = {}  # insertion-ordered
+        self.active: dict[str, MatchmakerTicket] = {}
+        self.session_tickets: dict[str, set[str]] = {}
+        self.party_tickets: dict[str, set[str]] = {}
+
+        self._paused = False
+        self._stopped = False
+        self._task: asyncio.Task | None = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def pause(self):
+        self._paused = True
+
+    def resume(self):
+        self._paused = False
+
+    def stop(self):
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def start(self):
+        """Spawn the per-interval processing task (reference
+        matchmaker.go:250-260)."""
+
+        async def _loop():
+            while not self._stopped:
+                await asyncio.sleep(self.config.interval_sec)
+                if not self._paused:
+                    try:
+                        self.process()
+                    except Exception as e:  # never kill the interval loop
+                        self.logger.error("matchmaker process error", error=str(e))
+
+        self._task = asyncio.get_running_loop().create_task(_loop())
+
+    # ------------------------------------------------------------------ add
+
+    def add(
+        self,
+        presences: list[MatchmakerPresence],
+        session_id: str,
+        party_id: str,
+        query: str,
+        min_count: int,
+        max_count: int,
+        count_multiple: int = 1,
+        string_properties: dict[str, str] | None = None,
+        numeric_properties: dict[str, float] | None = None,
+    ) -> tuple[str, float]:
+        """Submit a ticket. Returns (ticket id, created_at seconds).
+
+        Reference Add: server/matchmaker.go:443-566."""
+        if self._stopped:
+            raise ErrNotAvailable("matchmaker stopped")
+        try:
+            parsed = parse_query(query)
+        except QueryError as e:
+            raise ErrQueryInvalid(str(e)) from e
+
+        session_ids: set[str] = set()
+        for p in presences:
+            if p.session_id in session_ids:
+                raise ErrDuplicateSession(p.session_id)
+            session_ids.add(p.session_id)
+
+        max_tickets = self.config.max_tickets
+        for p in presences:
+            if len(self.session_tickets.get(p.session_id, ())) >= max_tickets:
+                raise ErrTooManyTickets(p.session_id)
+        if party_id and len(self.party_tickets.get(party_id, ())) >= max_tickets:
+            raise ErrTooManyTickets(party_id)
+
+        ticket_id = str(uuid.uuid4())
+        created_at = time.time()
+        string_properties = string_properties or {}
+        numeric_properties = numeric_properties or {}
+        entries = [
+            MatchmakerEntry(
+                ticket=ticket_id,
+                presence=p,
+                string_properties=string_properties,
+                numeric_properties=numeric_properties,
+                party_id=party_id,
+                create_time=created_at,
+            )
+            for p in presences
+        ]
+        ticket = MatchmakerTicket(
+            ticket=ticket_id,
+            query=query,
+            min_count=min_count,
+            max_count=max_count,
+            count_multiple=count_multiple,
+            session_id=session_id,
+            party_id=party_id,
+            entries=entries,
+            string_properties=string_properties,
+            numeric_properties=numeric_properties,
+            created_at=created_at,
+            parsed_query=parsed,
+        )
+        self._register(ticket)
+        return ticket_id, created_at
+
+    def _register(self, ticket: MatchmakerTicket, active: bool = True):
+        for sid in ticket.session_ids:
+            self.session_tickets.setdefault(sid, set()).add(ticket.ticket)
+        if ticket.party_id:
+            self.party_tickets.setdefault(ticket.party_id, set()).add(
+                ticket.ticket
+            )
+        self.tickets[ticket.ticket] = ticket
+        if active:
+            self.active[ticket.ticket] = ticket
+        self._update_gauges()
+
+    # -------------------------------------------------------------- process
+
+    def process(self):
+        """One matching interval (reference Process, matchmaker.go:282-441)."""
+        t0 = time.perf_counter()
+        actives = sorted(
+            self.active.values(), key=lambda t: (t.created_at, t.created_seq)
+        )
+        if self.override_fn is not None:
+            matched, expired = process_custom(
+                actives,
+                self.tickets,
+                max_intervals=self.config.max_intervals,
+                rev_precision=self.config.rev_precision,
+                override_fn=self.override_fn,
+            )
+        else:
+            matched, expired = self.backend.process(
+                actives,
+                self.tickets,
+                max_intervals=self.config.max_intervals,
+                rev_precision=self.config.rev_precision,
+            )
+
+        for ticket_id in expired:
+            self.active.pop(ticket_id, None)
+
+        # Remove matched tickets from the pool. A set may have been raced out
+        # by an explicit removal between snapshot and now (possible only for
+        # override fns that suspend); drop such sets defensively.
+        confirmed: list[list[MatchmakerEntry]] = []
+        for entry_set in matched:
+            if all(e.ticket in self.tickets for e in entry_set):
+                confirmed.append(entry_set)
+                for e in entry_set:
+                    self._unregister(e.ticket)
+
+        if self.metrics is not None:
+            self.metrics.mm_process_time.observe(time.perf_counter() - t0)
+            self.metrics.mm_matched.inc(
+                sum(len(s) for s in confirmed) or 0
+            )
+            self._update_gauges()
+
+        if confirmed and self.on_matched is not None:
+            self.on_matched(confirmed)
+        return confirmed
+
+    # -------------------------------------------------------------- removal
+
+    def _unregister(self, ticket_id: str):
+        ticket = self.tickets.pop(ticket_id, None)
+        if ticket is None:
+            return
+        self.active.pop(ticket_id, None)
+        for sid in ticket.session_ids:
+            tickets = self.session_tickets.get(sid)
+            if tickets is not None:
+                tickets.discard(ticket_id)
+                if not tickets:
+                    del self.session_tickets[sid]
+        if ticket.party_id:
+            tickets = self.party_tickets.get(ticket.party_id)
+            if tickets is not None:
+                tickets.discard(ticket_id)
+                if not tickets:
+                    del self.party_tickets[ticket.party_id]
+
+    def remove_session(self, session_id: str, ticket_id: str):
+        """Ownership-checked removal (reference matchmaker.go:725)."""
+        if ticket_id not in self.session_tickets.get(session_id, ()):
+            raise MatchmakerError("ticket not found")
+        self._unregister(ticket_id)
+        self._update_gauges()
+
+    def remove_session_all(self, session_id: str):
+        for ticket_id in list(self.session_tickets.get(session_id, ())):
+            self._unregister(ticket_id)
+        self._update_gauges()
+
+    def remove_party(self, party_id: str, ticket_id: str):
+        if ticket_id not in self.party_tickets.get(party_id, ()):
+            raise MatchmakerError("ticket not found")
+        self._unregister(ticket_id)
+        self._update_gauges()
+
+    def remove_party_all(self, party_id: str):
+        for ticket_id in list(self.party_tickets.get(party_id, ())):
+            self._unregister(ticket_id)
+        self._update_gauges()
+
+    def remove_all(self, node: str):
+        for ticket_id in list(self.tickets):
+            # Single-node build: every ticket belongs to this node.
+            if node == self.node:
+                self._unregister(ticket_id)
+        self._update_gauges()
+
+    def remove(self, ticket_ids: list[str]):
+        for ticket_id in ticket_ids:
+            self._unregister(ticket_id)
+        self._update_gauges()
+
+    # ------------------------------------------------------ extract / insert
+
+    def extract(self) -> list[MatchmakerExtract]:
+        """Export all tickets for node-drain handover (matchmaker.go:684)."""
+        out = []
+        for t in self.tickets.values():
+            out.append(
+                MatchmakerExtract(
+                    presences=[e.presence for e in t.entries],
+                    session_id=t.session_id,
+                    party_id=t.party_id,
+                    query=t.query,
+                    min_count=t.min_count,
+                    max_count=t.max_count,
+                    count_multiple=t.count_multiple,
+                    string_properties=dict(t.string_properties),
+                    numeric_properties=dict(t.numeric_properties),
+                    ticket=t.ticket,
+                    created_at=t.created_at,
+                    intervals=t.intervals,
+                )
+            )
+        return out
+
+    def insert(self, extracts: list[MatchmakerExtract]):
+        """Bulk-import tickets from another node (matchmaker.go:567)."""
+        for ex in extracts:
+            try:
+                parsed = parse_query(ex.query)
+            except QueryError:
+                self.logger.warn("insert: dropping bad query", ticket=ex.ticket)
+                continue
+            entries = [
+                MatchmakerEntry(
+                    ticket=ex.ticket,
+                    presence=p,
+                    string_properties=ex.string_properties,
+                    numeric_properties=ex.numeric_properties,
+                    party_id=ex.party_id,
+                    create_time=ex.created_at,
+                )
+                for p in ex.presences
+            ]
+            ticket = MatchmakerTicket(
+                ticket=ex.ticket,
+                query=ex.query,
+                min_count=ex.min_count,
+                max_count=ex.max_count,
+                count_multiple=ex.count_multiple,
+                session_id=ex.session_id,
+                party_id=ex.party_id,
+                entries=entries,
+                string_properties=dict(ex.string_properties),
+                numeric_properties=dict(ex.numeric_properties),
+                created_at=ex.created_at,
+                intervals=ex.intervals,
+                parsed_query=parsed,
+            )
+            self._register(ticket)
+
+    # -------------------------------------------------------------- helpers
+
+    def _update_gauges(self):
+        if self.metrics is not None:
+            self.metrics.mm_tickets.set(len(self.tickets))
+            self.metrics.mm_active_tickets.set(len(self.active))
+
+    def __len__(self) -> int:
+        return len(self.tickets)

@@ -1,0 +1,113 @@
+"""Matchmaker data model.
+
+Capability parity with the reference ticket model (reference
+server/matchmaker.go:61-130): a ticket carries one entry per presence (a
+party ticket carries several), string+numeric properties, a query, min/max
+count, count multiple, and bookkeeping used by the process loop. Extract is
+the node-drain handover format (server/matchmaker.go:110-130).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any
+
+_created_seq = itertools.count(1)
+
+
+@dataclass(frozen=True)
+class MatchmakerPresence:
+    user_id: str
+    session_id: str
+    username: str = ""
+    node: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "user_id": self.user_id,
+            "session_id": self.session_id,
+            "username": self.username,
+        }
+
+
+@dataclass
+class MatchmakerEntry:
+    ticket: str
+    presence: MatchmakerPresence
+    string_properties: dict[str, str] = field(default_factory=dict)
+    numeric_properties: dict[str, float] = field(default_factory=dict)
+    party_id: str = ""
+    create_time: float = 0.0
+
+    @property
+    def properties(self) -> dict[str, Any]:
+        return {**self.string_properties, **self.numeric_properties}
+
+
+@dataclass
+class MatchmakerTicket:
+    """One pool entry (reference MatchmakerIndex, server/matchmaker.go:88-108)."""
+
+    ticket: str
+    query: str
+    min_count: int
+    max_count: int
+    count_multiple: int
+    session_id: str  # "" for party tickets
+    party_id: str  # "" for solo tickets
+    entries: list[MatchmakerEntry]
+    string_properties: dict[str, str]
+    numeric_properties: dict[str, float]
+    created_at: float  # wall-clock seconds
+    created_seq: int = 0  # monotone tiebreaker, assigned by the pool
+    intervals: int = 0
+    parsed_query: Any = None  # query AST, set on add
+
+    def __post_init__(self):
+        if self.created_seq == 0:
+            self.created_seq = next(_created_seq)
+
+    @property
+    def count(self) -> int:
+        return len(self.entries)
+
+    @property
+    def session_ids(self) -> set[str]:
+        return {e.presence.session_id for e in self.entries}
+
+    def document(self) -> dict[str, Any]:
+        """The searchable view of this ticket (reference MapMatchmakerIndex,
+        server/matchmaker.go:1026-1040): ticket fields + flattened
+        ``properties.*`` keys."""
+        doc: dict[str, Any] = {
+            "ticket": self.ticket,
+            "min_count": float(self.min_count),
+            "max_count": float(self.max_count),
+            "party_id": self.party_id,
+            "created_at": float(self.created_at),
+        }
+        for k, v in self.string_properties.items():
+            doc[f"properties.{k}"] = v
+        for k, v in self.numeric_properties.items():
+            doc[f"properties.{k}"] = float(v)
+        return doc
+
+
+@dataclass
+class MatchmakerExtract:
+    """Ticket handover/checkpoint format for node drain
+    (reference MatchmakerExtract, server/matchmaker.go:110-130)."""
+
+    presences: list[MatchmakerPresence]
+    session_id: str
+    party_id: str
+    query: str
+    min_count: int
+    max_count: int
+    count_multiple: int
+    string_properties: dict[str, str]
+    numeric_properties: dict[str, float]
+    ticket: str
+    created_at: float
+    intervals: int = 0

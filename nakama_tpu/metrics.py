@@ -13,7 +13,7 @@ embedded servers never collide on the global default registry.
 from __future__ import annotations
 
 import time
-from typing import Iterator
+from typing import Any
 
 from prometheus_client import (
     CollectorRegistry,
@@ -154,5 +154,5 @@ class _Timed:
         return False
 
 
-def timed(histogram: Histogram) -> Iterator[None]:
+def timed(histogram: Histogram) -> _Timed:
     return _Timed(histogram)

@@ -1,0 +1,144 @@
+"""Query language tests — scenarios drawn from reference
+server/matchmaker_test.go query strings (see SURVEY.md §2.5)."""
+
+import pytest
+
+from nakama_tpu.matchmaker.query import (
+    BooleanQuery,
+    MatchAll,
+    NumericEq,
+    NumericRange,
+    QueryError,
+    Regexp,
+    Term,
+    evaluate,
+    matches,
+    parse_query,
+)
+
+
+def doc(**props):
+    return {f"properties.{k}": v for k, v in props.items()}
+
+
+def test_match_all():
+    q = parse_query("*")
+    assert isinstance(q, MatchAll)
+    assert matches(q, doc(a1="foo"))
+    assert matches(q, {})
+
+
+def test_simple_term():
+    q = parse_query("properties.a1:foo")
+    assert matches(q, doc(a1="foo"))
+    assert not matches(q, doc(a1="bar"))
+    assert not matches(q, doc(a2="foo"))
+
+
+def test_must_and_must_not():
+    q = parse_query("+properties.game_mode:foo -properties.region:eu")
+    assert matches(q, doc(game_mode="foo", region="us"))
+    assert matches(q, doc(game_mode="foo"))
+    assert not matches(q, doc(game_mode="foo", region="eu"))
+    assert not matches(q, doc(game_mode="bar", region="us"))
+
+
+def test_should_semantics():
+    # No must clauses: at least one should must match.
+    q = parse_query("properties.a6:bar properties.a6:foo")
+    assert matches(q, doc(a6="bar"))
+    assert matches(q, doc(a6="foo"))
+    assert not matches(q, doc(a6="baz"))
+    # With a must clause, shoulds become optional score boosters.
+    q = parse_query("+properties.id:x properties.a6:bar")
+    assert matches(q, doc(id="x", a6="nope"))
+    assert evaluate(q, doc(id="x", a6="bar")) > evaluate(q, doc(id="x", a6="no"))
+
+
+def test_numeric_ranges():
+    q = parse_query("+properties.b1:>=10 +properties.b1:<=20")
+    assert matches(q, doc(b1=10.0))
+    assert matches(q, doc(b1=15))
+    assert matches(q, doc(b1=20.0))
+    assert not matches(q, doc(b1=9.9))
+    assert not matches(q, doc(b1=20.1))
+    assert not matches(q, doc(b1="15"))  # string value ≠ numeric range
+
+    q = parse_query("properties.n1:<10")
+    assert matches(q, doc(n1=9.99))
+    assert not matches(q, doc(n1=10))
+    q = parse_query("properties.n1:>10")
+    assert not matches(q, doc(n1=10))
+    assert matches(q, doc(n1=10.01))
+
+
+def test_numeric_equality():
+    q = parse_query("properties.b1:10")
+    assert matches(q, doc(b1=10.0))
+    assert not matches(q, doc(b1=10.5))
+
+
+def test_boost_scoring():
+    # Reference scenario (matchmaker_test.go:1853-1977): boosted clause
+    # dominates ordering under constant-score similarity.
+    q = parse_query("+properties.foo:bar properties.b1:10^10")
+    base = evaluate(q, doc(foo="bar", b1=99))
+    boosted = evaluate(q, doc(foo="bar", b1=10))
+    assert base == pytest.approx(1.0)
+    assert boosted == pytest.approx(11.0)
+
+    q = parse_query("properties.n1:<10^10")
+    assert evaluate(q, doc(n1=5)) == pytest.approx(10.0)
+
+
+def test_regex():
+    q = parse_query(
+        "+properties.game_mode:foo -properties.blocked:/.*4bd6667a\\-2659.*/"
+    )
+    assert matches(q, doc(game_mode="foo", blocked="nobody"))
+    assert not matches(
+        q, doc(game_mode="foo", blocked="x,4bd6667a-2659,y")
+    )
+    q = parse_query("+properties.maps:/.*(map2|map3).*/")
+    assert matches(q, doc(maps="map1,map2"))
+    assert not matches(q, doc(maps="map1,map4"))
+
+
+def test_wildcard():
+    q = parse_query("properties.region:eu-*")
+    assert matches(q, doc(region="eu-west"))
+    assert not matches(q, doc(region="us-east"))
+
+
+def test_uuid_term_with_hyphens():
+    tid = "4bd6667a-2659-4888-b245-e13690ff4a9b"
+    q = parse_query("+properties.id:" + tid)
+    assert matches(q, doc(id=tid))
+    assert not matches(q, doc(id="other"))
+
+
+def test_quoted_term():
+    q = parse_query('properties.name:"hello world"')
+    assert matches(q, doc(name="hello world"))
+    assert not matches(q, doc(name="hello"))
+
+
+def test_only_must_not():
+    q = parse_query("-properties.blocked:yes")
+    assert matches(q, doc(blocked="no"))
+    assert matches(q, {})
+    assert not matches(q, doc(blocked="yes"))
+
+
+def test_parse_errors():
+    with pytest.raises(QueryError):
+        parse_query('properties.a:"unterminated')
+    with pytest.raises(QueryError):
+        parse_query("properties.a:>abc")
+    with pytest.raises(QueryError):
+        parse_query("properties.a:/bad[/")
+
+
+def test_missing_field_never_matches():
+    q = parse_query("+properties.rank:>=5")
+    assert not matches(q, doc(other=10))
