@@ -1,0 +1,288 @@
+"""Device-resident ticket pool + the pairwise-eligibility top-K kernel.
+
+The TPU re-design of the reference's per-interval Bluge index walk
+(reference server/matchmaker_process.go:27-334): instead of one TopN inverted
+-index search per active ticket, ALL active tickets score ALL pool tickets in
+one blockwise device pass — flash-attention-style streaming over column
+blocks with a running top-K per row, so the full N×N matrix never
+materializes. Mutual-match ("reverse precision") is the same computation
+transposed, evaluated in the same block — the reference's revCache memo
+(server/matchmaker.go:1042-1068) becomes unnecessary.
+
+Eligibility is evaluated in per-field form (see compile.py): a gather-free
+broadcast compare-and-reduce over [col_block, row_block, F] that runs at
+full VPU rate. The optional should-clause scoring path uses small slot
+gathers and is compiled in only when the pool contains should queries.
+
+PoolBuffer keeps the ticket tensors device-resident and applies queued
+add/remove updates as one scatter per interval, so `Add` streams vectors in
+instead of re-uploading the pool (BASELINE.md host↔device budget note).
+Update counts, active counts, and the scanned column extent are padded to
+power-of-two buckets so XLA compiles a handful of program shapes, not one
+per interval.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .compile import SOP_ALL, SOP_NUM_RANGE, SOP_STR_EQ, SOP_UNUSED
+
+NEG_INF = np.float32(-np.inf)
+
+# Flag bits in the "flags" column.
+FLAG_VALID = 1
+FLAG_HAS_MUST = 2
+FLAG_HAS_SHOULD = 4
+FLAG_NEVER = 8
+
+# Tie-break: equal-score candidates prefer longer-waiting tickets. The
+# penalty must stay below the smallest meaningful score gap; boosts are
+# user-supplied, so this is a documented resolution limit of the device path
+# (the native assembler re-sorts the surviving K exactly; must-only queries
+# have no score at all and order purely by wait).
+CREATED_EPS = np.float32(2.0**-24)
+
+
+def pool_schema(capacity: int, fn: int, fs: int, s: int) -> dict[str, np.ndarray]:
+    """Allocate host templates of the device pool arrays."""
+    return {
+        "num": np.zeros((capacity, fn), dtype=np.float32),
+        "str": np.zeros((capacity, fs), dtype=np.int32),
+        "n_lo": np.zeros((capacity, fn), dtype=np.float32),
+        "n_hi": np.zeros((capacity, fn), dtype=np.float32),
+        "n_flo": np.ones((capacity, fn), dtype=np.float32),
+        "n_fhi": np.full((capacity, fn), -1.0, dtype=np.float32),
+        "s_req": np.zeros((capacity, fs), dtype=np.int32),
+        "s_forb": np.zeros((capacity, fs), dtype=np.int32),
+        "sh_op": np.zeros((capacity, s), dtype=np.int32),
+        "sh_fld": np.zeros((capacity, s), dtype=np.int32),
+        "sh_lo": np.zeros((capacity, s), dtype=np.float32),
+        "sh_hi": np.zeros((capacity, s), dtype=np.float32),
+        "sh_term": np.zeros((capacity, s), dtype=np.int32),
+        "sh_boost": np.zeros((capacity, s), dtype=np.float32),
+        "min_count": np.zeros(capacity, dtype=np.int32),
+        "max_count": np.zeros(capacity, dtype=np.int32),
+        "party": np.zeros(capacity, dtype=np.int32),
+        "pool_id": np.zeros(capacity, dtype=np.int32),
+        "created": np.zeros(capacity, dtype=np.int32),  # monotone seq
+        "flags": np.zeros(capacity, dtype=np.int32),
+    }
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter(pool: dict, idx: jnp.ndarray, rows: dict) -> dict:
+    return {k: pool[k].at[idx].set(rows[k]) for k in pool}
+
+
+class PoolBuffer:
+    """Slot-allocated, device-resident ticket pool with queued updates."""
+
+    def __init__(self, capacity: int, fn: int, fs: int, s: int):
+        self.capacity = capacity
+        self.fn, self.fs, self.s = fn, fs, s
+        host = pool_schema(capacity, fn, fs, s)
+        self.device = jax.tree.map(jnp.asarray, host)
+        self._empty_row = {
+            k: v[0].copy() for k, v in pool_schema(1, fn, fs, s).items()
+        }
+        # LIFO free list popping slot 0 first: the pool stays dense at the
+        # low end, so the kernel can stop at the high-water mark.
+        self._free = list(range(capacity - 1, -1, -1))
+        self.high_water = 0
+        self._pending_idx: list[int] = []
+        self._pending_rows: list[dict[str, np.ndarray]] = []
+        self.slot_of: dict[str, int] = {}  # ticket id -> slot
+
+    def __len__(self) -> int:
+        return len(self.slot_of)
+
+    def add(self, ticket_id: str, row: dict[str, np.ndarray]) -> int:
+        if not self._free:
+            raise RuntimeError("matchmaker pool capacity exceeded")
+        slot = self._free.pop()
+        self.slot_of[ticket_id] = slot
+        self.high_water = max(self.high_water, slot + 1)
+        self._pending_idx.append(slot)
+        self._pending_rows.append(row)
+        return slot
+
+    def remove(self, ticket_id: str):
+        slot = self.slot_of.pop(ticket_id, None)
+        if slot is None:
+            return
+        self._free.append(slot)
+        self._pending_idx.append(slot)
+        self._pending_rows.append(self._empty_row)
+
+    def flush(self):
+        """Apply queued updates as one device scatter.
+
+        The update count is padded to a power of two (repeating the last
+        row — an idempotent duplicate write) so XLA compiles one scatter per
+        size bucket instead of one per distinct update count."""
+        if not self._pending_idx:
+            return
+        u = len(self._pending_idx)
+        u_pad = 1 << (u - 1).bit_length()
+        idx = np.asarray(
+            self._pending_idx + [self._pending_idx[-1]] * (u_pad - u),
+            dtype=np.int32,
+        )
+        rows = self._pending_rows + [self._pending_rows[-1]] * (u_pad - u)
+        stacked = {k: np.stack([r[k] for r in rows]) for k in self.device}
+        self.device = _scatter(
+            self.device, jnp.asarray(idx), jax.tree.map(jnp.asarray, stacked)
+        )
+        self._pending_idx.clear()
+        self._pending_rows.clear()
+
+
+def _accepts(qrow: dict, fcol: dict, with_should: bool):
+    """Does each q-side ticket's query accept each f-side ticket's
+    properties? Returns (ok [Bc, Br], score [Bc, Br] or 0.0).
+
+    qrow arrays are [Br, ...], fcol arrays are [Bc, ...]; outputs orient
+    feature-axis first."""
+    num = fcol["num"][:, None, :]  # [Bc, 1, Fn]
+    ok_num = jnp.all(
+        (num >= qrow["n_lo"][None])
+        & (num <= qrow["n_hi"][None])
+        & ~((num >= qrow["n_flo"][None]) & (num <= qrow["n_fhi"][None])),
+        axis=-1,
+    )  # [Bc, Br]
+    sv = fcol["str"][:, None, :]  # [Bc, 1, Fs]
+    req = qrow["s_req"][None]
+    forb = qrow["s_forb"][None]
+    ok_str = jnp.all(
+        ((req == 0) | (sv == req)) & ((forb == 0) | (sv != forb)), axis=-1
+    )
+    flags = qrow["flags"][None]  # [1, Br]
+    ok = ok_num & ok_str & ((flags & FLAG_NEVER) == 0)
+
+    if not with_should:
+        return ok, jnp.float32(0.0)
+
+    # Should slots: gather candidate values per slot — only compiled in when
+    # the pool actually contains should queries.
+    op = qrow["sh_op"][None]  # [1, Br, S]
+    numvals = jnp.take(fcol["num"], qrow["sh_fld"], axis=1)  # [Bc, Br, S]
+    strvals = jnp.take(fcol["str"], qrow["sh_fld"], axis=1)
+    sat = jnp.where(
+        op == SOP_NUM_RANGE,
+        (numvals >= qrow["sh_lo"][None]) & (numvals <= qrow["sh_hi"][None]),
+        jnp.where(
+            op == SOP_STR_EQ,
+            (strvals == qrow["sh_term"][None]) & (qrow["sh_term"][None] != 0),
+            op == SOP_ALL,
+        ),
+    )
+    used = op != SOP_UNUSED
+    should_any = jnp.any(used & sat, axis=-1)
+    score = jnp.sum(qrow["sh_boost"][None] * jnp.where(sat & used, 1.0, 0.0), axis=-1)
+    has_must = (flags & FLAG_HAS_MUST) != 0
+    has_should = (flags & FLAG_HAS_SHOULD) != 0
+    ok = ok & (has_must | ~has_should | should_any)
+    return ok, score
+
+
+def _block_eval(row, col, row_slot, col_base, rev: bool, with_should: bool):
+    """Score one (row-block, column-block) pair → scores [Br, Bc]
+    (−inf = ineligible)."""
+    bc = col["num"].shape[0]
+
+    ok, score = _accepts(row, col, with_should)  # [Bc, Br]
+    if rev:
+        rev_ok, _ = _accepts(col, row, with_should)  # [Br, Bc]
+        ok = ok & rev_ok.T
+
+    # Count-range compatibility + party/self/validity (reference
+    # matchmaker_process.go:65-85) + shared-batch pool masking.
+    col_valid = (col["flags"] & FLAG_VALID) != 0  # [Bc]
+    minmax_ok = (col["min_count"][:, None] >= row["min_count"][None]) & (
+        col["max_count"][:, None] <= row["max_count"][None]
+    )
+    party_ok = (row["party"][None] == 0) | (
+        col["party"][:, None] != row["party"][None]
+    )
+    pool_ok = col["pool_id"][:, None] == row["pool_id"][None]
+    col_idx = col_base + jnp.arange(bc, dtype=jnp.int32)
+    not_self = col_idx[:, None] != row_slot[None]
+
+    eligible = (
+        ok & col_valid[:, None] & minmax_ok & party_ok & pool_ok & not_self
+    )
+    score = score - col["created"][:, None].astype(jnp.float32) * CREATED_EPS
+    return jnp.where(eligible, score, NEG_INF).T  # [Br, Bc]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "br", "bc", "rev", "n_cols", "with_should")
+)
+def topk_candidates(
+    pool: dict,
+    active_slots: jnp.ndarray,  # i32 [A_pad], padded with -1
+    *,
+    k: int,
+    br: int,
+    bc: int,
+    rev: bool,
+    n_cols: int,
+    with_should: bool,
+):
+    """For each active ticket, the top-k eligible candidates by
+    (score desc, created asc): returns (scores [A_pad, k], slots [A_pad, k]
+    with -1 for empty). Only the first n_cols pool slots are scanned (the
+    bucketed high-water mark)."""
+    pool = {key: v[:n_cols] for key, v in pool.items()}
+    a_pad = active_slots.shape[0]
+    n_row_blocks = a_pad // br
+    n_col_blocks = n_cols // bc
+
+    def row_block(rb):
+        slots = jax.lax.dynamic_slice_in_dim(active_slots, rb * br, br)
+        safe = jnp.maximum(slots, 0)
+        row = {k_: v[safe] for k_, v in pool.items()}
+        row_valid = slots >= 0
+
+        def col_step(state, cb):
+            best_s, best_i = state
+            col = {
+                k_: jax.lax.dynamic_slice_in_dim(v, cb * bc, bc, axis=0)
+                for k_, v in pool.items()
+            }
+            s = _block_eval(row, col, safe, cb * bc, rev, with_should)
+            s = jnp.where(row_valid[:, None], s, NEG_INF)
+            idx = cb * bc + jnp.arange(bc, dtype=jnp.int32)
+            cat_s = jnp.concatenate([best_s, s], axis=1)
+            cat_i = jnp.concatenate(
+                [best_i, jnp.broadcast_to(idx, (br, bc))], axis=1
+            )
+            new_s, sel = jax.lax.top_k(cat_s, k)
+            new_i = jnp.take_along_axis(cat_i, sel, axis=1)
+            return (new_s, new_i), None
+
+        init = (
+            jnp.full((br, k), NEG_INF),
+            jnp.full((br, k), -1, dtype=jnp.int32),
+        )
+        (best_s, best_i), _ = jax.lax.scan(
+            col_step, init, jnp.arange(n_col_blocks)
+        )
+        best_i = jnp.where(best_s > NEG_INF, best_i, -1)
+        return best_s, best_i
+
+    scores, idxs = jax.lax.map(row_block, jnp.arange(n_row_blocks))
+    return scores.reshape(a_pad, k), idxs.reshape(a_pad, k)
+
+
+def pad_to(x: np.ndarray, size: int, fill) -> np.ndarray:
+    if x.shape[0] == size:
+        return x
+    out = np.full((size, *x.shape[1:]), fill, dtype=x.dtype)
+    out[: x.shape[0]] = x
+    return out

@@ -61,6 +61,12 @@ OverrideFn = Callable[
 
 
 class ProcessBackend(Protocol):
+    def on_add(self, ticket: MatchmakerTicket) -> None:
+        """Called before a ticket enters the pool; may raise to reject it."""
+
+    def on_remove(self, ticket_id: str) -> None:
+        """Called when a ticket leaves the pool."""
+
     def process(
         self,
         actives: list[MatchmakerTicket],
@@ -73,6 +79,12 @@ class ProcessBackend(Protocol):
 
 class CpuBackend:
     """The oracle backend — exact reference semantics on host."""
+
+    def on_add(self, ticket: MatchmakerTicket) -> None:
+        pass
+
+    def on_remove(self, ticket_id: str) -> None:
+        pass
 
     def process(self, actives, pool, *, max_intervals, rev_precision):
         return process_default(
@@ -209,6 +221,10 @@ class LocalMatchmaker:
         return ticket_id, created_at
 
     def _register(self, ticket: MatchmakerTicket, active: bool = True):
+        # Backend first: a rejection (pool capacity, party size) must leave
+        # the local maps untouched or every later interval breaks on the
+        # orphaned ticket.
+        self.backend.on_add(ticket)
         for sid in ticket.session_ids:
             self.session_tickets.setdefault(sid, set()).add(ticket.ticket)
         if ticket.party_id:
@@ -275,6 +291,7 @@ class LocalMatchmaker:
         if ticket is None:
             return
         self.active.pop(ticket_id, None)
+        self.backend.on_remove(ticket_id)
         for sid in ticket.session_ids:
             tickets = self.session_tickets.get(sid)
             if tickets is not None:

@@ -6,10 +6,14 @@ formation (reference server/matchmaker_process.go:27-334 `processDefault`,
 the correctness oracle for the TPU backend and the 1k-ticket parity baseline
 (BASELINE.md config 1).
 
-Differences from the reference, both deliberate:
+Deliberate differences from the reference:
 - Iteration over active tickets is oldest-first (created order) instead of Go
   map order — deterministic for tests.
 - The reverse-query memo cache is unnecessary (pure functions, small N).
+- After a count-multiple trim, the ACTIVE ticket's own min/max bounds are
+  re-checked (the reference's final cross-check covers combo members only,
+  matchmaker_process.go:287-296, so a trim could shrink a match below the
+  searcher's min_count).
 """
 
 from __future__ import annotations
@@ -83,15 +87,19 @@ def process_default(
     *,
     max_intervals: int,
     rev_precision: bool,
+    bump_intervals: bool = True,
+    preselected: set[str] | None = None,
 ) -> tuple[list[list[MatchmakerEntry]], list[str]]:
     """One interval of default match formation.
 
-    Mutates each active ticket's `intervals` count. Returns (matched entry
-    sets, expired active ticket ids). Matched tickets must then be removed
-    from the pool by the caller (reference matchmaker.go:320-372)."""
+    Bumps each active ticket's `intervals` count unless the caller already
+    did (bump_intervals=False — the TpuBackend host-only pass). `preselected`
+    tickets are treated as already matched this interval. Returns (matched
+    entry sets, expired active ticket ids). Matched tickets must then be
+    removed from the pool by the caller (reference matchmaker.go:320-372)."""
     matched_entries: list[list[MatchmakerEntry]] = []
     expired_actives: list[str] = []
-    selected: set[str] = set()
+    selected: set[str] = set(preselected or ())
 
     for active in actives:
         # Already matched earlier in this same iteration (reference
@@ -100,7 +108,8 @@ def process_default(
         if active.ticket in selected:
             continue
 
-        active.intervals += 1
+        if bump_intervals:
+            active.intervals += 1
         last_interval = (
             active.intervals >= max_intervals
             or active.min_count == active.max_count
@@ -188,6 +197,13 @@ def process_default(
                 ]
                 size = len(found_combo) + active.count
                 if size % active.count_multiple != 0:
+                    continue
+                # Deliberate fix over the reference: re-check the active
+                # ticket's own bounds after trimming (the reference's final
+                # cross-check covers combo members only,
+                # matchmaker_process.go:287-296, so a trim can shrink a match
+                # below the searcher's min_count).
+                if not (active.min_count <= size <= active.max_count):
                     continue
 
             # Final cross-member validation (matchmaker_process.go:287-296).

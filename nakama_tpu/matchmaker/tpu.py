@@ -1,0 +1,289 @@
+"""TpuBackend: the production matchmaker process path.
+
+Per interval (the reference's LocalMatchmaker.Process hot loop re-framed,
+SURVEY.md §2.5):
+
+1. flush queued ticket updates into the device pool buffer (one scatter),
+2. run the blockwise pairwise-eligibility + top-K kernel on device for every
+   active, compilable ticket at once,
+3. hand the candidate lists to the native C++ greedy assembler for the exact
+   sequential combo formation,
+4. run the CPU oracle for the rare host-only actives (regex/wildcard queries
+   or field-budget overflow) over the leftover pool,
+5. when rev_precision is on, post-validate combo-internal mutual matches on
+   host with the real query ASTs (group sizes are small).
+
+Host-side per-slot metadata (counts, intervals, session hashes) lives in
+persistent numpy arrays updated on add/remove, so an interval never loops
+over the whole pool in Python.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import MatchmakerConfig
+from ..logger import Logger
+from ..metrics import Metrics
+from .. import native
+from .compile import (
+    FULL_HI,
+    FULL_LO,
+    CompiledQuery,
+    FieldRegistry,
+    HostOnlyQuery,
+    compile_features,
+    compile_query,
+    hash64,
+    hash_str,
+)
+from .device import (
+    FLAG_HAS_MUST,
+    FLAG_HAS_SHOULD,
+    FLAG_NEVER,
+    FLAG_VALID,
+    PoolBuffer,
+    pad_to,
+    topk_candidates,
+)
+from .process import _mutual, process_default
+from .types import MatchmakerEntry, MatchmakerTicket
+
+
+class TpuBackend:
+    """ProcessBackend implementation running on the JAX default device."""
+
+    def __init__(
+        self,
+        config: MatchmakerConfig,
+        logger: Logger,
+        metrics: Metrics | None = None,
+        row_block: int = 256,
+        col_block: int = 2048,
+    ):
+        self.config = config
+        self.logger = logger.with_fields(subsystem="matchmaker.tpu")
+        self.metrics = metrics
+        cap = config.pool_capacity
+        self.fn = config.numeric_fields
+        self.fs = config.string_fields
+        self.s = config.max_constraints
+        self.k = config.candidates_per_ticket
+        self.row_block = row_block
+        self.col_block = min(col_block, cap)
+        if cap % self.col_block:
+            raise ValueError("pool_capacity must be a multiple of col_block")
+
+        self.registry = FieldRegistry(self.fn, self.fs)
+        self.pool = PoolBuffer(cap, self.fn, self.fs, self.s)
+
+        # Host-side per-slot metadata for the native assembler.
+        sps = config.max_party_size
+        self.meta = {
+            "min_count": np.zeros(cap, dtype=np.int32),
+            "max_count": np.zeros(cap, dtype=np.int32),
+            "count_multiple": np.ones(cap, dtype=np.int32),
+            "count": np.zeros(cap, dtype=np.int32),
+            "intervals": np.zeros(cap, dtype=np.int32),
+            "created": np.zeros(cap, dtype=np.int64),
+            "session_hashes": np.zeros((cap, sps), dtype=np.uint64),
+            "session_counts": np.zeros(cap, dtype=np.int32),
+        }
+        self.ticket_at: list[MatchmakerTicket | None] = [None] * cap
+        self.host_only: set[str] = set()
+        self._should_tickets: set[str] = set()
+
+    # -------------------------------------------------- pool notifications
+
+    def on_add(self, ticket: MatchmakerTicket, pool_id: int = 0):
+        num, strs, overflow = compile_features(ticket, self.registry)
+        host_only = overflow
+        cq: CompiledQuery | None = None
+        if not host_only:
+            try:
+                cq = compile_query(ticket, self.registry, self.s)
+            except HostOnlyQuery as e:
+                self.logger.debug(
+                    "host-only query", ticket=ticket.ticket, reason=str(e)
+                )
+                host_only = True
+        if host_only:
+            self.host_only.add(ticket.ticket)
+        if cq is not None and cq.has_should:
+            self._should_tickets.add(ticket.ticket)
+
+        flags = FLAG_VALID
+        if cq is not None:
+            if cq.has_must:
+                flags |= FLAG_HAS_MUST
+            if cq.has_should:
+                flags |= FLAG_HAS_SHOULD
+            if cq.never:
+                flags |= FLAG_NEVER
+
+        fn, fs, s = self.fn, self.fs, self.s
+        row = {
+            "num": num,
+            "str": strs,
+            # Host-only queries store accept-all constraints so the reverse
+            # (mutual) direction treats them as accepting; the host
+            # post-validation applies their real query.
+            "n_lo": cq.n_lo if cq else np.full(fn, FULL_LO, np.float32),
+            "n_hi": cq.n_hi if cq else np.full(fn, FULL_HI, np.float32),
+            "n_flo": cq.n_flo if cq else np.ones(fn, np.float32),
+            "n_fhi": cq.n_fhi if cq else np.full(fn, -1.0, np.float32),
+            "s_req": cq.s_req if cq else np.zeros(fs, np.int32),
+            "s_forb": cq.s_forb if cq else np.zeros(fs, np.int32),
+            "sh_op": cq.sh_op if cq else np.zeros(s, np.int32),
+            "sh_fld": cq.sh_fld if cq else np.zeros(s, np.int32),
+            "sh_lo": cq.sh_lo if cq else np.zeros(s, np.float32),
+            "sh_hi": cq.sh_hi if cq else np.zeros(s, np.float32),
+            "sh_term": cq.sh_term if cq else np.zeros(s, np.int32),
+            "sh_boost": cq.sh_boost if cq else np.zeros(s, np.float32),
+            "min_count": np.int32(ticket.min_count),
+            "max_count": np.int32(ticket.max_count),
+            "party": np.int32(
+                hash_str(ticket.party_id) if ticket.party_id else 0
+            ),
+            "pool_id": np.int32(pool_id),
+            "created": np.int32(ticket.created_seq),
+            "flags": np.int32(flags),
+        }
+        slot = self.pool.add(ticket.ticket, row)
+
+        m = self.meta
+        m["min_count"][slot] = ticket.min_count
+        m["max_count"][slot] = ticket.max_count
+        m["count_multiple"][slot] = ticket.count_multiple
+        m["count"][slot] = ticket.count
+        m["intervals"][slot] = ticket.intervals
+        m["created"][slot] = int(ticket.created_at * 1e9)
+        sessions = sorted(ticket.session_ids)
+        stride = m["session_hashes"].shape[1]
+        if len(sessions) > stride:
+            raise ValueError(
+                f"party size {len(sessions)} exceeds max_party_size {stride}"
+            )
+        m["session_counts"][slot] = len(sessions)
+        for i, sid in enumerate(sessions):
+            m["session_hashes"][slot, i] = hash64(sid)
+        self.ticket_at[slot] = ticket
+
+    def on_remove(self, ticket_id: str):
+        slot = self.pool.slot_of.get(ticket_id)
+        if slot is not None:
+            self.ticket_at[slot] = None
+            self.meta["session_counts"][slot] = 0
+        self.pool.remove(ticket_id)
+        self.host_only.discard(ticket_id)
+        self._should_tickets.discard(ticket_id)
+
+    # -------------------------------------------------------------- process
+
+    def process(
+        self,
+        actives: list[MatchmakerTicket],
+        pool: dict[str, MatchmakerTicket],
+        *,
+        max_intervals: int,
+        rev_precision: bool,
+    ) -> tuple[list[list[MatchmakerEntry]], list[str]]:
+        # Interval bookkeeping, vectorized (reference bumps per-active in the
+        # loop; equivalent because matched actives leave the pool anyway).
+        expired: list[str] = []
+        device_actives: list[MatchmakerTicket] = []
+        host_actives: list[MatchmakerTicket] = []
+        for t in actives:
+            t.intervals += 1
+            if t.intervals >= max_intervals or t.min_count == t.max_count:
+                expired.append(t.ticket)
+            (host_actives if t.ticket in self.host_only else device_actives).append(t)
+
+        matched: list[list[MatchmakerEntry]] = []
+        selected: set[str] = set()
+
+        if device_actives:
+            slots = np.asarray(
+                [self.pool.slot_of[t.ticket] for t in device_actives],
+                dtype=np.int32,
+            )
+            self.meta["intervals"][slots] = [
+                t.intervals for t in device_actives
+            ]
+            last_interval = np.asarray(
+                [
+                    t.intervals >= max_intervals or t.min_count == t.max_count
+                    for t in device_actives
+                ],
+                dtype=np.uint8,
+            )
+
+            self.pool.flush()
+            # Pad counts to power-of-two buckets: one compiled program per
+            # bucket, not per interval.
+            n_blocks = -(-len(slots) // self.row_block)
+            a_pad = self.row_block * (1 << (n_blocks - 1).bit_length())
+            col_blocks = -(-self.pool.high_water // self.col_block)
+            n_cols = min(
+                self.col_block * (1 << max(0, col_blocks - 1).bit_length()),
+                self.pool.capacity,
+            )
+            scores, cand = topk_candidates(
+                self.pool.device,
+                pad_to(slots, a_pad, -1),
+                k=min(self.k, n_cols),
+                br=self.row_block,
+                bc=self.col_block,
+                rev=rev_precision,
+                n_cols=n_cols,
+                with_should=bool(self._should_tickets),
+            )
+            cand_np = np.ascontiguousarray(np.asarray(cand)[: len(slots)])
+
+            slot_matches = native.assemble(
+                slots,
+                last_interval,
+                cand_np,
+                min_count=self.meta["min_count"],
+                max_count=self.meta["max_count"],
+                count_multiple=self.meta["count_multiple"],
+                count=self.meta["count"],
+                intervals=self.meta["intervals"],
+                created=self.meta["created"],
+                session_hashes=self.meta["session_hashes"],
+                session_counts=self.meta["session_counts"],
+            )
+
+            for match_slots in slot_matches:
+                tickets = [self.ticket_at[s] for s in match_slots]
+                if any(t is None for t in tickets):
+                    continue
+                if rev_precision and not self._mutual_group(tickets):
+                    continue
+                entries: list[MatchmakerEntry] = []
+                for t in tickets:
+                    entries.extend(t.entries)
+                matched.append(entries)
+                selected.update(t.ticket for t in tickets)
+
+        if host_actives:
+            host_matched, _ = process_default(
+                host_actives,
+                pool,
+                max_intervals=max_intervals,
+                rev_precision=rev_precision,
+                bump_intervals=False,
+                preselected=selected,
+            )
+            matched.extend(host_matched)
+
+        return matched, expired
+
+    def _mutual_group(self, tickets: list[MatchmakerTicket]) -> bool:
+        """Combo-internal mutual validation with real query ASTs (the device
+        kernel only guarantees mutuality against the active ticket)."""
+        for i in range(len(tickets)):
+            for j in range(len(tickets)):
+                if i != j and not _mutual(tickets[i], tickets[j]):
+                    return False
+        return True

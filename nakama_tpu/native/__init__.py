@@ -1,0 +1,119 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The shared library is built from the sources in this directory with
+``make -C nakama_tpu/native``; `load()` builds it on first use when the
+toolchain is available so a fresh checkout works without a manual step.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libnakama_native.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _newer(a: str, b: str) -> bool:
+    return os.path.getmtime(a) > os.path.getmtime(b)
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the native library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_DIR, "assembler.cpp")
+        if not os.path.exists(_LIB_PATH) or _newer(src, _LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR, "-s"],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                raise NativeUnavailable(
+                    f"cannot build native library: {detail}"
+                ) from e
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.mm_assemble.restype = ctypes.c_int32
+        _lib = lib
+        return lib
+
+
+def _ptr(arr: np.ndarray, dtype) -> ctypes.c_void_p:
+    assert arr.dtype == dtype and arr.flags["C_CONTIGUOUS"], (
+        arr.dtype,
+        dtype,
+    )
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def assemble(
+    active_slots: np.ndarray,  # i32 [A]
+    last_interval: np.ndarray,  # u8 [A]
+    cand: np.ndarray,  # i32 [A, K]
+    *,
+    min_count: np.ndarray,
+    max_count: np.ndarray,
+    count_multiple: np.ndarray,
+    count: np.ndarray,
+    intervals: np.ndarray,
+    created: np.ndarray,  # i64 [slots]
+    session_hashes: np.ndarray,  # u64 [slots, stride]
+    session_counts: np.ndarray,  # i32 [slots]
+) -> list[list[int]]:
+    """Run the native greedy assembler; returns matches as slot lists, the
+    active ticket's slot last in each."""
+    lib = load()
+    a = len(active_slots)
+    if a == 0:
+        return []
+    k = cand.shape[1] if cand.ndim == 2 else 0
+    n_slots = len(min_count)
+    stride = session_hashes.shape[1]
+    max_matches = a + 1
+    max_slots_out = int(np.sum(count[active_slots])) + int(cand.size) * 2 + 64
+    out_offsets = np.zeros(max_matches + 1, dtype=np.int32)
+    out_slots = np.zeros(max_slots_out, dtype=np.int32)
+
+    n = lib.mm_assemble(
+        ctypes.c_int32(a),
+        _ptr(active_slots, np.int32),
+        _ptr(last_interval, np.uint8),
+        _ptr(cand, np.int32),
+        ctypes.c_int32(k),
+        _ptr(min_count, np.int32),
+        _ptr(max_count, np.int32),
+        _ptr(count_multiple, np.int32),
+        _ptr(count, np.int32),
+        _ptr(intervals, np.int32),
+        _ptr(created, np.int64),
+        _ptr(session_hashes, np.uint64),
+        _ptr(session_counts, np.int32),
+        ctypes.c_int32(stride),
+        ctypes.c_int32(n_slots),
+        _ptr(out_offsets, np.int32),
+        ctypes.c_int32(max_matches),
+        _ptr(out_slots, np.int32),
+        ctypes.c_int32(max_slots_out),
+    )
+    if n < 0:
+        raise RuntimeError("assembler output buffer overflow")
+    return [
+        out_slots[out_offsets[i] : out_offsets[i + 1]].tolist()
+        for i in range(n)
+    ]

@@ -1,0 +1,247 @@
+// Greedy match assembler — the sequential tail of the matchmaker interval.
+//
+// The TPU kernel reduces the O(N^2) pairwise search to per-active top-K
+// candidate lists; this native stage replays the reference's greedy combo
+// assembly over those lists with exact semantics (reference
+// server/matchmaker_process.go:112-325): in-order candidate placement into
+// combos, session-overlap rejection, exact-fit or last-interval-min
+// acceptance, count-multiple trimming via exact-size group search keeping
+// the youngest average (server/matchmaker.go:132-167), and final
+// cross-member min/max/multiple validation.
+//
+// Compiled to a shared library, driven through ctypes (native.py). All
+// inputs are flat arrays indexed by pool slot; strings never cross the
+// boundary (sessions/parties arrive as 64-bit hashes).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct TicketView {
+    int32_t min_count, max_count, count_multiple, count, intervals;
+    int64_t created;
+    const uint64_t* sessions;
+    int32_t n_sessions;
+};
+
+struct Pool {
+    const int32_t *min_count, *max_count, *count_multiple, *count, *intervals;
+    const int64_t* created;
+    const uint64_t* session_hashes;  // [n_slots, session_stride]
+    const int32_t* session_counts;   // [n_slots]
+    int32_t session_stride;
+
+    TicketView view(int32_t slot) const {
+        return TicketView{
+            min_count[slot],
+            max_count[slot],
+            count_multiple[slot],
+            count[slot],
+            intervals[slot],
+            created[slot],
+            session_hashes +
+                static_cast<int64_t>(slot) * session_stride,
+            session_counts[slot],
+        };
+    }
+};
+
+bool sessions_overlap(const TicketView& a, const TicketView& b) {
+    for (int32_t i = 0; i < a.n_sessions; ++i)
+        for (int32_t j = 0; j < b.n_sessions; ++j)
+            if (a.sessions[i] == b.sessions[j]) return true;
+    return false;
+}
+
+struct Group {
+    std::vector<int32_t> slots;
+    double avg_created;
+};
+
+// All subsets of `tickets` whose entry counts sum to exactly `required`
+// (reference groupIndexes, server/matchmaker.go:132-167).
+void group_tickets(const Pool& pool, const std::vector<int32_t>& tickets,
+                   size_t from, int32_t required, std::vector<int32_t>& cur,
+                   std::vector<Group>& out) {
+    if (required == 0) {
+        double sum = 0;
+        for (int32_t s : cur) sum += static_cast<double>(pool.created[s]);
+        out.push_back(Group{cur, cur.empty() ? 0.0 : sum / cur.size()});
+        return;
+    }
+    if (from >= tickets.size() || required < 0) return;
+    int32_t slot = tickets[from];
+    if (pool.count[slot] <= required) {
+        cur.push_back(slot);
+        group_tickets(pool, tickets, from + 1, required - pool.count[slot],
+                      cur, out);
+        cur.pop_back();
+    }
+    group_tickets(pool, tickets, from + 1, required, cur, out);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of matches written. Outputs:
+//   out_offsets: [max_matches+1] CSR offsets into out_slots
+//   out_slots:   [max_slots_out] matched pool slots per match; the ACTIVE
+//                ticket is always the last slot of its match.
+// A return of -1 means the output buffers were too small.
+int32_t mm_assemble(
+    // Active rows, already ordered oldest-first.
+    int32_t n_active, const int32_t* active_slots,
+    const uint8_t* last_interval,  // [n_active]
+    // Candidates: [n_active, k] pool slots, -1 = none (ordered best-first).
+    const int32_t* cand, int32_t k,
+    // Pool arrays indexed by slot.
+    const int32_t* min_count, const int32_t* max_count,
+    const int32_t* count_multiple, const int32_t* count,
+    const int32_t* intervals, const int64_t* created,
+    const uint64_t* session_hashes, const int32_t* session_counts,
+    int32_t session_stride, int32_t n_slots,
+    // Outputs.
+    int32_t* out_offsets, int32_t max_matches, int32_t* out_slots,
+    int32_t max_slots_out) {
+    Pool pool{min_count,      max_count,      count_multiple, count,
+              intervals,      created,        session_hashes, session_counts,
+              session_stride};
+
+    std::vector<uint8_t> selected(static_cast<size_t>(n_slots), 0);
+    int32_t n_matches = 0;
+    int64_t slots_used = 0;
+    out_offsets[0] = 0;
+
+    // Scratch combo storage: combos of ticket slots (entry counts tracked).
+    std::vector<std::vector<int32_t>> combos;
+
+    for (int32_t a = 0; a < n_active; ++a) {
+        int32_t aslot = active_slots[a];
+        if (selected[aslot]) continue;
+        TicketView active = pool.view(aslot);
+
+        combos.clear();
+        const int32_t* row = cand + static_cast<int64_t>(a) * k;
+
+        // Prune self/already-selected hits upfront (the reference removes
+        // them from the hit list before assembly, matchmaker_process.go:
+        // 112-126) so the last-hit acceptance index is over usable hits.
+        std::vector<int32_t> usable;
+        usable.reserve(k);
+        for (int32_t h = 0; h < k; ++h) {
+            int32_t hslot = row[h];
+            if (hslot < 0) break;
+            if (selected[hslot] || hslot == aslot) continue;
+            usable.push_back(hslot);
+        }
+        int32_t last_hit = static_cast<int32_t>(usable.size()) - 1;
+
+        for (int32_t h = 0; h < static_cast<int32_t>(usable.size()); ++h) {
+            int32_t hslot = usable[h];
+            if (selected[hslot]) continue;  // selected by an earlier combo
+            TicketView hit = pool.view(hslot);
+
+            if (sessions_overlap(active, hit)) continue;
+
+            // Place into the first combo with room and no session conflict.
+            std::vector<int32_t>* found = nullptr;
+            size_t found_idx = 0;
+            for (size_t c = 0; c < combos.size(); ++c) {
+                int32_t combo_entries = 0;
+                bool conflict = false;
+                for (int32_t s : combos[c]) {
+                    combo_entries += pool.count[s];
+                    if (sessions_overlap(pool.view(s), hit)) conflict = true;
+                }
+                if (conflict) continue;
+                if (combo_entries + hit.count + active.count >
+                    active.max_count)
+                    continue;
+                combos[c].push_back(hslot);
+                found = &combos[c];
+                found_idx = c;
+                break;
+            }
+            if (!found) {
+                combos.push_back({hslot});
+                found = &combos.back();
+                found_idx = combos.size() - 1;
+            }
+
+            int32_t size = active.count;
+            for (int32_t s : *found) size += pool.count[s];
+
+            bool accept =
+                size == active.max_count ||
+                (last_interval[a] && size >= active.min_count &&
+                 size <= active.max_count && h >= last_hit);
+            if (!accept) continue;
+
+            std::vector<int32_t> match = *found;
+            int32_t rem = size % active.count_multiple;
+            if (rem != 0) {
+                // Trim an exact-size group: drop the group with the smallest
+                // average created_at, matching the reference's observed
+                // behavior (ascending sort, remove index 0 —
+                // matchmaker_process.go:258-276).
+                std::vector<int32_t> eligible;
+                for (int32_t s : match)
+                    if (pool.count[s] <= rem) eligible.push_back(s);
+                std::vector<Group> groups;
+                std::vector<int32_t> cur;
+                group_tickets(pool, eligible, 0, rem, cur, groups);
+                if (groups.empty()) continue;
+                const Group* best = &groups[0];
+                for (const Group& g : groups)
+                    if (g.avg_created < best->avg_created) best = &g;
+                for (int32_t drop : best->slots) {
+                    for (size_t i = 0; i < match.size(); ++i)
+                        if (match[i] == drop) {
+                            match.erase(match.begin() + i);
+                            break;
+                        }
+                }
+                size = active.count;
+                for (int32_t s : match) size += pool.count[s];
+                if (size % active.count_multiple != 0) continue;
+                // Deliberate fix over the reference: a trim must not shrink
+                // the match below the active ticket's own min_count (the
+                // reference's final cross-check covers combo members only).
+                if (size < active.min_count || size > active.max_count)
+                    continue;
+            }
+
+            // Final cross-member validation.
+            bool ok = true;
+            for (int32_t s : match) {
+                if (pool.min_count[s] > size || pool.max_count[s] < size ||
+                    size % pool.count_multiple[s] != 0) {
+                    ok = false;
+                    break;
+                }
+            }
+            if (!ok) continue;
+
+            // Emit: combo slots then the active slot.
+            if (n_matches >= max_matches ||
+                slots_used + static_cast<int64_t>(match.size()) + 1 >
+                    max_slots_out)
+                return -1;
+            for (int32_t s : match) {
+                out_slots[slots_used++] = s;
+                selected[s] = 1;
+            }
+            out_slots[slots_used++] = aslot;
+            selected[aslot] = 1;
+            ++n_matches;
+            out_offsets[n_matches] = static_cast<int32_t>(slots_used);
+            combos.erase(combos.begin() + found_idx);
+            break;
+        }
+    }
+    return n_matches;
+}
+}
