@@ -86,6 +86,7 @@ class MatchmakerConfig:
     numeric_fields: int = 24
     string_fields: int = 16
     max_party_size: int = 8
+    embedding_dims: int = 16  # learned skill-embedding width
 
 
 @dataclass

@@ -48,9 +48,12 @@ FLAG_NEVER = 8
 CREATED_EPS = np.float32(2.0**-24)
 
 
-def pool_schema(capacity: int, fn: int, fs: int, s: int) -> dict[str, np.ndarray]:
+def pool_schema(
+    capacity: int, fn: int, fs: int, s: int, d: int = 16
+) -> dict[str, np.ndarray]:
     """Allocate host templates of the device pool arrays."""
     return {
+        "emb": np.zeros((capacity, d), dtype=np.float32),
         "num": np.zeros((capacity, fn), dtype=np.float32),
         "str": np.zeros((capacity, fs), dtype=np.int32),
         "n_lo": np.zeros((capacity, fn), dtype=np.float32),
@@ -82,13 +85,13 @@ def _scatter(pool: dict, idx: jnp.ndarray, rows: dict) -> dict:
 class PoolBuffer:
     """Slot-allocated, device-resident ticket pool with queued updates."""
 
-    def __init__(self, capacity: int, fn: int, fs: int, s: int):
+    def __init__(self, capacity: int, fn: int, fs: int, s: int, d: int = 16):
         self.capacity = capacity
-        self.fn, self.fs, self.s = fn, fs, s
-        host = pool_schema(capacity, fn, fs, s)
+        self.fn, self.fs, self.s, self.d = fn, fs, s, d
+        host = pool_schema(capacity, fn, fs, s, d)
         self.device = jax.tree.map(jnp.asarray, host)
         self._empty_row = {
-            k: v[0].copy() for k, v in pool_schema(1, fn, fs, s).items()
+            k: v[0].copy() for k, v in pool_schema(1, fn, fs, s, d).items()
         }
         # LIFO free list popping slot 0 first: the pool stays dense at the
         # low end, so the kernel can stop at the high-water mark.
@@ -190,7 +193,10 @@ def _accepts(qrow: dict, fcol: dict, with_should: bool):
     return ok, score
 
 
-def _block_eval(row, col, row_slot, col_base, rev: bool, with_should: bool):
+def _block_eval(
+    row, col, row_slot, col_base, rev: bool, with_should: bool,
+    with_embedding: bool,
+):
     """Score one (row-block, column-block) pair → scores [Br, Bc]
     (−inf = ineligible)."""
     bc = col["num"].shape[0]
@@ -199,6 +205,12 @@ def _block_eval(row, col, row_slot, col_base, rev: bool, with_should: bool):
     if rev:
         rev_ok, _ = _accepts(col, row, with_should)  # [Br, Bc]
         ok = ok & rev_ok.T
+    if with_embedding:
+        # Skill-similarity scoring on the MXU (BASELINE.md config 3): higher
+        # dot product = better-matched candidates.
+        score = score + jnp.einsum(
+            "cd,rd->cr", col["emb"], row["emb"]
+        )
 
     # Count-range compatibility + party/self/validity (reference
     # matchmaker_process.go:65-85) + shared-batch pool masking.
@@ -220,8 +232,64 @@ def _block_eval(row, col, row_slot, col_base, rev: bool, with_should: bool):
     return jnp.where(eligible, score, NEG_INF).T  # [Br, Bc]
 
 
+def scan_columns(
+    pool_view: dict,
+    row: dict,
+    row_slots,
+    row_valid,
+    *,
+    k: int,
+    br: int,
+    bc: int,
+    n_col_blocks: int,
+    col_base0,
+    rev: bool,
+    with_should: bool,
+    with_embedding: bool,
+    varying_axis: str | None = None,
+):
+    """Stream column blocks of `pool_view` against one row block, carrying a
+    running top-k. Shared by the single-device kernel and the mesh-sharded
+    path (which passes its shard offset as col_base0 and names its mesh axis
+    so the carry is marked device-varying for shard_map)."""
+
+    def col_step(state, cb):
+        best_s, best_i = state
+        col = {
+            key: jax.lax.dynamic_slice_in_dim(v, cb * bc, bc, axis=0)
+            for key, v in pool_view.items()
+        }
+        s = _block_eval(
+            row, col, row_slots, col_base0 + cb * bc, rev, with_should,
+            with_embedding,
+        )
+        s = jnp.where(row_valid[:, None], s, NEG_INF)
+        idx = col_base0 + cb * bc + jnp.arange(bc, dtype=jnp.int32)
+        cat_s = jnp.concatenate([best_s, s], axis=1)
+        cat_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(idx, (br, bc))], axis=1
+        )
+        new_s, sel = jax.lax.top_k(cat_s, k)
+        new_i = jnp.take_along_axis(cat_i, sel, axis=1)
+        return (new_s, new_i), None
+
+    init = (
+        jnp.full((br, k), NEG_INF),
+        jnp.full((br, k), -1, dtype=jnp.int32),
+    )
+    if varying_axis is not None:
+        init = jax.lax.pcast(init, (varying_axis,), to="varying")
+    (best_s, best_i), _ = jax.lax.scan(
+        col_step, init, jnp.arange(n_col_blocks)
+    )
+    return best_s, best_i
+
+
 @functools.partial(
-    jax.jit, static_argnames=("k", "br", "bc", "rev", "n_cols", "with_should")
+    jax.jit,
+    static_argnames=(
+        "k", "br", "bc", "rev", "n_cols", "with_should", "with_embedding",
+    ),
 )
 def topk_candidates(
     pool: dict,
@@ -233,6 +301,7 @@ def topk_candidates(
     rev: bool,
     n_cols: int,
     with_should: bool,
+    with_embedding: bool = False,
 ):
     """For each active ticket, the top-k eligible candidates by
     (score desc, created asc): returns (scores [A_pad, k], slots [A_pad, k]
@@ -247,31 +316,19 @@ def topk_candidates(
         slots = jax.lax.dynamic_slice_in_dim(active_slots, rb * br, br)
         safe = jnp.maximum(slots, 0)
         row = {k_: v[safe] for k_, v in pool.items()}
-        row_valid = slots >= 0
-
-        def col_step(state, cb):
-            best_s, best_i = state
-            col = {
-                k_: jax.lax.dynamic_slice_in_dim(v, cb * bc, bc, axis=0)
-                for k_, v in pool.items()
-            }
-            s = _block_eval(row, col, safe, cb * bc, rev, with_should)
-            s = jnp.where(row_valid[:, None], s, NEG_INF)
-            idx = cb * bc + jnp.arange(bc, dtype=jnp.int32)
-            cat_s = jnp.concatenate([best_s, s], axis=1)
-            cat_i = jnp.concatenate(
-                [best_i, jnp.broadcast_to(idx, (br, bc))], axis=1
-            )
-            new_s, sel = jax.lax.top_k(cat_s, k)
-            new_i = jnp.take_along_axis(cat_i, sel, axis=1)
-            return (new_s, new_i), None
-
-        init = (
-            jnp.full((br, k), NEG_INF),
-            jnp.full((br, k), -1, dtype=jnp.int32),
-        )
-        (best_s, best_i), _ = jax.lax.scan(
-            col_step, init, jnp.arange(n_col_blocks)
+        best_s, best_i = scan_columns(
+            pool,
+            row,
+            safe,
+            slots >= 0,
+            k=k,
+            br=br,
+            bc=bc,
+            n_col_blocks=n_col_blocks,
+            col_base0=0,
+            rev=rev,
+            with_should=with_should,
+            with_embedding=with_embedding,
         )
         best_i = jnp.where(best_s > NEG_INF, best_i, -1)
         return best_s, best_i

@@ -164,6 +164,7 @@ class LocalMatchmaker:
         count_multiple: int = 1,
         string_properties: dict[str, str] | None = None,
         numeric_properties: dict[str, float] | None = None,
+        embedding=None,
     ) -> tuple[str, float]:
         """Submit a ticket. Returns (ticket id, created_at seconds).
 
@@ -216,6 +217,7 @@ class LocalMatchmaker:
             numeric_properties=numeric_properties,
             created_at=created_at,
             parsed_query=parsed,
+            embedding=embedding,
         )
         self._register(ticket)
         return ticket_id, created_at
@@ -360,6 +362,7 @@ class LocalMatchmaker:
                     ticket=t.ticket,
                     created_at=t.created_at,
                     intervals=t.intervals,
+                    embedding=t.embedding,
                 )
             )
         return out
@@ -397,6 +400,7 @@ class LocalMatchmaker:
                 created_at=ex.created_at,
                 intervals=ex.intervals,
                 parsed_query=parsed,
+                embedding=ex.embedding,
             )
             self._register(ticket)
 

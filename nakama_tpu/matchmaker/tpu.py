@@ -74,8 +74,9 @@ class TpuBackend:
         if cap % self.col_block:
             raise ValueError("pool_capacity must be a multiple of col_block")
 
+        self.d = config.embedding_dims
         self.registry = FieldRegistry(self.fn, self.fs)
-        self.pool = PoolBuffer(cap, self.fn, self.fs, self.s)
+        self.pool = PoolBuffer(cap, self.fn, self.fs, self.s, self.d)
 
         # Host-side per-slot metadata for the native assembler.
         sps = config.max_party_size
@@ -92,10 +93,27 @@ class TpuBackend:
         self.ticket_at: list[MatchmakerTicket | None] = [None] * cap
         self.host_only: set[str] = set()
         self._should_tickets: set[str] = set()
+        self._embedding_tickets: set[str] = set()
 
     # -------------------------------------------------- pool notifications
 
     def on_add(self, ticket: MatchmakerTicket, pool_id: int = 0):
+        # Validate and compile everything BEFORE mutating any backend state,
+        # so a rejected add (bad embedding, pool capacity, party size) leaves
+        # the backend exactly as it was.
+        sessions = sorted(ticket.session_ids)
+        stride = self.meta["session_hashes"].shape[1]
+        if len(sessions) > stride:
+            raise ValueError(
+                f"party size {len(sessions)} exceeds max_party_size {stride}"
+            )
+        emb = np.zeros(self.d, dtype=np.float32)
+        if ticket.embedding is not None:
+            e = np.asarray(ticket.embedding, dtype=np.float32)
+            if e.shape != (self.d,):
+                raise ValueError(f"embedding shape {e.shape} != ({self.d},)")
+            emb = e
+
         num, strs, overflow = compile_features(ticket, self.registry)
         host_only = overflow
         cq: CompiledQuery | None = None
@@ -107,10 +125,6 @@ class TpuBackend:
                     "host-only query", ticket=ticket.ticket, reason=str(e)
                 )
                 host_only = True
-        if host_only:
-            self.host_only.add(ticket.ticket)
-        if cq is not None and cq.has_should:
-            self._should_tickets.add(ticket.ticket)
 
         flags = FLAG_VALID
         if cq is not None:
@@ -123,6 +137,7 @@ class TpuBackend:
 
         fn, fs, s = self.fn, self.fs, self.s
         row = {
+            "emb": emb,
             "num": num,
             "str": strs,
             # Host-only queries store accept-all constraints so the reverse
@@ -150,6 +165,12 @@ class TpuBackend:
             "flags": np.int32(flags),
         }
         slot = self.pool.add(ticket.ticket, row)
+        if host_only:
+            self.host_only.add(ticket.ticket)
+        if cq is not None and cq.has_should:
+            self._should_tickets.add(ticket.ticket)
+        if ticket.embedding is not None:
+            self._embedding_tickets.add(ticket.ticket)
 
         m = self.meta
         m["min_count"][slot] = ticket.min_count
@@ -158,12 +179,6 @@ class TpuBackend:
         m["count"][slot] = ticket.count
         m["intervals"][slot] = ticket.intervals
         m["created"][slot] = int(ticket.created_at * 1e9)
-        sessions = sorted(ticket.session_ids)
-        stride = m["session_hashes"].shape[1]
-        if len(sessions) > stride:
-            raise ValueError(
-                f"party size {len(sessions)} exceeds max_party_size {stride}"
-            )
         m["session_counts"][slot] = len(sessions)
         for i, sid in enumerate(sessions):
             m["session_hashes"][slot, i] = hash64(sid)
@@ -177,6 +192,7 @@ class TpuBackend:
         self.pool.remove(ticket_id)
         self.host_only.discard(ticket_id)
         self._should_tickets.discard(ticket_id)
+        self._embedding_tickets.discard(ticket_id)
 
     # -------------------------------------------------------------- process
 
@@ -237,6 +253,7 @@ class TpuBackend:
                 rev=rev_precision,
                 n_cols=n_cols,
                 with_should=bool(self._should_tickets),
+                with_embedding=bool(self._embedding_tickets),
             )
             cand_np = np.ascontiguousarray(np.asarray(cand)[: len(slots)])
 

@@ -63,6 +63,9 @@ class MatchmakerTicket:
     created_seq: int = 0  # monotone tiebreaker, assigned by the pool
     intervals: int = 0
     parsed_query: Any = None  # query AST, set on add
+    # Optional learned skill embedding (BASELINE.md config 3): candidates are
+    # scored by dot-product similarity on the MXU in addition to boosts.
+    embedding: Any = None  # np.ndarray [D] | None
 
     def __post_init__(self):
         if self.created_seq == 0:
@@ -111,3 +114,4 @@ class MatchmakerExtract:
     ticket: str
     created_at: float
     intervals: int = 0
+    embedding: Any = None

@@ -1,0 +1,8 @@
+"""Learned models backing the matchmaker (TPU-native additions with no
+reference equivalent — the reference scores tickets with hand-written
+queries only; we add a learned skill-embedding pathway, BASELINE.md
+config 3)."""
+
+from .skill import SkillModel, SkillTrainState, create_train_state, train_step
+
+__all__ = ["SkillModel", "SkillTrainState", "create_train_state", "train_step"]

@@ -1,0 +1,115 @@
+"""Skill-embedding model: player stats → D-dim skill vector.
+
+The matchmaker's learned pathway (BASELINE.md config 3): each player's
+recent-stats vector is encoded to a D-dim embedding; the matchmaker's device
+kernel scores candidate pairs by embedding dot product on the MXU, and match
+outcomes train the encoder with a Bradley–Terry objective — the probability
+team A beats team B is sigmoid(strength(A) − strength(B)), where a team's
+strength is the mean of its members' embeddings projected through a learned
+head (a neural generalisation of Elo/TrueSkill-style ratings).
+
+The training step is written mesh-first: `train_step` is a plain jittable
+function whose inputs carry shardings (dp over the batch, tp over the hidden
+dim), so the same code runs single-chip or under a Mesh via jit sharding
+propagation — see parallel/mesh.py and __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+
+class SkillModel(nn.Module):
+    """MLP encoder + scalar strength head."""
+
+    embed_dim: int = 16
+    hidden_dim: int = 128
+    stat_dim: int = 32
+
+    @nn.compact
+    def __call__(self, stats: jnp.ndarray) -> jnp.ndarray:
+        """stats [..., stat_dim] → embedding [..., embed_dim]."""
+        x = nn.Dense(self.hidden_dim, name="in_proj")(stats)
+        x = nn.gelu(x)
+        x = nn.Dense(self.hidden_dim, name="mid_proj")(x)
+        x = nn.gelu(x)
+        emb = nn.Dense(self.embed_dim, name="out_proj")(x)
+        return emb
+
+
+@dataclass
+class SkillTrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray  # i32 scalar (a data leaf so jit caching is stable)
+
+
+def _init_params(model: SkillModel, rng):
+    stats = jnp.zeros((1, model.stat_dim), jnp.float32)
+    params = model.init(rng, stats)
+    # Strength head lives beside the encoder params.
+    head = jax.random.normal(
+        jax.random.fold_in(rng, 1), (model.embed_dim, 1), jnp.float32
+    ) * 0.1
+    params = {"params": {**params["params"], "head": {"kernel": head}}}
+    return params
+
+
+def create_train_state(
+    model: SkillModel, rng, learning_rate: float = 1e-3
+) -> tuple[SkillTrainState, optax.GradientTransformation]:
+    params = _init_params(model, rng)
+    tx = optax.adamw(learning_rate)
+    state = SkillTrainState(
+        params, tx.init(params), jnp.zeros((), jnp.int32)
+    )
+    return state, tx
+
+
+def outcome_loss(
+    model: SkillModel,
+    params,
+    team_a_stats: jnp.ndarray,  # [B, T, stat_dim]
+    team_b_stats: jnp.ndarray,  # [B, T, stat_dim]
+    a_won: jnp.ndarray,  # [B] float 0/1
+) -> jnp.ndarray:
+    """Bradley–Terry log-loss over team mean strengths."""
+
+    def team_strength(stats):
+        emb = model.apply(params, stats)  # [B, T, D]
+        head = params["params"]["head"]["kernel"]  # [D, 1]
+        return (emb.mean(axis=1) @ head).squeeze(-1)  # [B]
+
+    logits = team_strength(team_a_stats) - team_strength(team_b_stats)
+    return optax.sigmoid_binary_cross_entropy(logits, a_won).mean()
+
+
+def train_step(
+    model: SkillModel,
+    tx: optax.GradientTransformation,
+    state: SkillTrainState,
+    batch: dict[str, jnp.ndarray],
+) -> tuple[SkillTrainState, jnp.ndarray]:
+    """One SGD step; jittable (close over model and tx):
+    ``jax.jit(partial(train_step, model, tx))``."""
+    loss, grads = jax.value_and_grad(
+        lambda p: outcome_loss(
+            model, p, batch["team_a"], batch["team_b"], batch["a_won"]
+        )
+    )(state.params)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return SkillTrainState(params, opt_state, state.step + 1), loss
+
+
+jax.tree_util.register_dataclass(
+    SkillTrainState,
+    data_fields=["params", "opt_state", "step"],
+    meta_fields=[],
+)

@@ -1,0 +1,11 @@
+"""Multi-device parallelism for the matchmaker and models.
+
+The reference is single-node with interface seams for a closed-source
+cluster edition (SURVEY.md §2.8); our scale-out axis is the device mesh:
+the ticket pool shards across devices along the candidate axis (ICI
+collectives merge per-shard top-K), and model training shards dp/tp.
+"""
+
+from .mesh import build_row_data, make_mesh, shard_pool, sharded_topk_rows
+
+__all__ = ["build_row_data", "make_mesh", "shard_pool", "sharded_topk_rows"]
