@@ -1,0 +1,3 @@
+"""Transport / API layer (reference L4, SURVEY.md §2.6): session JWTs, the
+realtime envelope protocol over WebSocket, the per-message pipeline, and the
+HTTP/REST API server."""

@@ -1,0 +1,83 @@
+"""Matchmaker matched-event routing.
+
+Parity with the tail of the reference Process loop (reference
+server/matchmaker.go:377-435): for each formed match, consult the runtime's
+MatchmakerMatched hook — a returned match id sends users to an authoritative
+match; otherwise mint a short-lived match token (30s JWT naming every user)
+for relayed-match rendezvous — then route a `matchmaker_matched` envelope to
+every matched presence.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..logger import Logger
+from ..matchmaker.types import MatchmakerEntry
+from ..realtime import PresenceID
+from . import session_token
+
+MATCH_TOKEN_EXPIRY_SEC = 30
+
+
+def make_matched_handler(
+    logger: Logger,
+    router: Any,
+    node: str,
+    encryption_key: str,
+    runtime: Any = None,
+):
+    log = logger.with_fields(subsystem="matchmaker.matched")
+
+    def on_matched(matched: list[list[MatchmakerEntry]]):
+        for entries in matched:
+            ticket_of = {e.presence.session_id: e.ticket for e in entries}
+            match_id = ""
+            if runtime is not None:
+                hook = runtime.matchmaker_matched()
+                if hook is not None:
+                    try:
+                        match_id = hook(entries) or ""
+                    except Exception as e:
+                        log.error("matchmaker matched hook error", error=str(e))
+
+            if not match_id:
+                user_list = ",".join(
+                    sorted(
+                        f"{e.presence.user_id}:{e.presence.username}"
+                        for e in entries
+                    )
+                )
+                token, _ = session_token.generate(
+                    encryption_key,
+                    user_list,
+                    "",
+                    MATCH_TOKEN_EXPIRY_SEC,
+                    vars={"kind": "match_token", "node": node},
+                )
+
+            users = [
+                {
+                    "presence": e.presence.as_dict(),
+                    "party_id": e.party_id,
+                    "string_properties": e.string_properties,
+                    "numeric_properties": e.numeric_properties,
+                }
+                for e in entries
+            ]
+            for entry in entries:
+                body: dict = {
+                    "ticket": ticket_of[entry.presence.session_id],
+                    "users": users,
+                    "self": {"presence": entry.presence.as_dict()},
+                }
+                if match_id:
+                    body["match_id"] = match_id
+                else:
+                    body["token"] = token
+                router.send_to_presence_ids(
+                    [PresenceID(node, entry.presence.session_id)],
+                    {"matchmaker_matched": body},
+                )
+
+    return on_matched

@@ -1,0 +1,288 @@
+"""Realtime message pipeline.
+
+Parity with the reference Pipeline (reference server/pipeline.go:63-189):
+every incoming envelope is validated to exactly one known variant, wrapped
+with the runtime's before/after realtime hooks when registered, and
+dispatched to its handler. Handlers mirror the reference's pipeline_*.go
+files; handlers whose backing component isn't wired yet answer with a
+structured error rather than disconnecting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..logger import Logger
+from ..metrics import Metrics
+from ..realtime import PresenceMeta, Stream, StreamMode
+from .envelope import REQUEST_KEYS, ErrorCode, error, message_key
+
+
+@dataclass
+class Components:
+    """Everything the pipeline can touch; optional parts arrive as the
+    framework is wired up (reference Pipeline struct, server/pipeline.go:27)."""
+
+    config: Any
+    tracker: Any
+    router: Any
+    status_registry: Any
+    matchmaker: Any = None
+    match_registry: Any = None
+    party_registry: Any = None
+    channels: Any = None  # channel core module facade
+    runtime: Any = None
+    metrics: Metrics | None = None
+    extra: dict = field(default_factory=dict)
+
+
+class Pipeline:
+    def __init__(self, logger: Logger, components: Components):
+        self.logger = logger.with_fields(subsystem="pipeline")
+        self.c = components
+
+    # ------------------------------------------------------------ dispatch
+
+    async def process(self, session, envelope: dict) -> bool:
+        key = message_key(envelope)
+        cid = envelope.get("cid", "")
+        if key is None:
+            session.send(
+                error(
+                    ErrorCode.MISSING_PAYLOAD
+                    if not [k for k in envelope if k != "cid"]
+                    else ErrorCode.UNRECOGNIZED_PAYLOAD,
+                    "exactly one message variant required",
+                    cid,
+                )
+            )
+            return True
+        if key not in REQUEST_KEYS:
+            session.send(
+                error(
+                    ErrorCode.UNRECOGNIZED_PAYLOAD,
+                    f"unrecognized message: {key}",
+                    cid,
+                )
+            )
+            return True
+
+        handler = getattr(self, f"_h_{key}", None)
+        if handler is None:
+            session.send(
+                error(ErrorCode.BAD_INPUT, f"{key} not available", cid)
+            )
+            return True
+
+        body = envelope[key]
+        if not isinstance(body, dict):
+            body = {}
+
+        runtime = self.c.runtime
+        if runtime is not None and key != "rpc":
+            before = runtime.before_rt(key)
+            if before is not None:
+                try:
+                    body = await _maybe_await(before(session, key, body))
+                except Exception as e:
+                    session.send(
+                        error(ErrorCode.RUNTIME_EXCEPTION, str(e), cid)
+                    )
+                    return True
+                if body is None:
+                    # Hook rejected the message silently.
+                    return True
+
+        try:
+            await _maybe_await(handler(session, cid, body))
+        except PipelineError as e:
+            session.send(error(e.code, str(e), cid))
+        except Exception as e:
+            self.logger.error("pipeline handler error", key=key, error=str(e))
+            session.send(error(ErrorCode.RUNTIME_EXCEPTION, "internal error", cid))
+            return True
+
+        if runtime is not None and key != "rpc":
+            after = runtime.after_rt(key)
+            if after is not None:
+                try:
+                    await _maybe_await(after(session, key, body))
+                except Exception as e:
+                    self.logger.error("after hook error", key=key, error=str(e))
+        return True
+
+    # ---------------------------------------------------------------- ping
+
+    def _h_ping(self, session, cid, body):
+        out: dict = {"pong": {}}
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _h_pong(self, session, cid, body):
+        pass
+
+    # ---------------------------------------------------------- matchmaker
+
+    def _h_matchmaker_add(self, session, cid, body):
+        """Reference pipeline_matchmaker.go:23-101."""
+        mm = _require(self.c.matchmaker, "matchmaker")
+        min_count = int(body.get("min_count", 0))
+        max_count = int(body.get("max_count", 0))
+        multiple = int(body.get("count_multiple", 1) or 1)
+        query = body.get("query") or "*"
+        if min_count < 2:
+            raise PipelineError("invalid min count")
+        if max_count < min_count:
+            raise PipelineError("invalid max count")
+        if multiple < 1 or min_count % multiple or max_count % multiple:
+            raise PipelineError("invalid count multiple")
+        from ..matchmaker import MatchmakerError, MatchmakerPresence
+
+        presence = MatchmakerPresence(
+            user_id=session.user_id,
+            session_id=session.id,
+            username=session.username,
+        )
+        string_props = {
+            k: str(v)
+            for k, v in (body.get("string_properties") or {}).items()
+        }
+        numeric_props = {
+            k: float(v)
+            for k, v in (body.get("numeric_properties") or {}).items()
+        }
+        try:
+            ticket, _ = mm.add(
+                [presence],
+                session.id,
+                "",
+                query,
+                min_count,
+                max_count,
+                multiple,
+                string_props,
+                numeric_props,
+            )
+        except MatchmakerError as e:
+            raise PipelineError(str(e) or type(e).__name__) from e
+        out: dict = {"matchmaker_ticket": {"ticket": ticket}}
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _h_matchmaker_remove(self, session, cid, body):
+        mm = _require(self.c.matchmaker, "matchmaker")
+        ticket = body.get("ticket", "")
+        if not ticket:
+            raise PipelineError("ticket required")
+        from ..matchmaker import MatchmakerError
+
+        try:
+            mm.remove_session(session.id, ticket)
+        except MatchmakerError as e:
+            raise PipelineError("ticket not found") from e
+        out: dict = {}
+        if cid:
+            out["cid"] = cid
+        if out:
+            session.send(out)
+
+    # -------------------------------------------------------------- status
+
+    def _h_status_follow(self, session, cid, body):
+        """Reference pipeline_status.go statusFollow."""
+        user_ids = set(body.get("user_ids") or [])
+        self.c.status_registry.follow(session.id, user_ids)
+        presences = []
+        for uid in user_ids:
+            for p in self.c.tracker.list_by_stream(
+                Stream(StreamMode.STATUS, subject=uid)
+            ):
+                presences.append(
+                    {
+                        "user_id": p.user_id,
+                        "username": p.meta.username,
+                        "status": p.meta.status,
+                    }
+                )
+        out: dict = {"status": {"presences": presences}}
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _h_status_unfollow(self, session, cid, body):
+        self.c.status_registry.unfollow(
+            session.id, set(body.get("user_ids") or [])
+        )
+        out: dict = {}
+        if cid:
+            out["cid"] = cid
+            session.send(out)
+
+    def _h_status_update(self, session, cid, body):
+        status = str(body.get("status", ""))
+        if len(status) > 2048:
+            raise PipelineError("status too long")
+        self.c.tracker.update(
+            session.id,
+            Stream(StreamMode.STATUS, subject=session.user_id),
+            session.user_id,
+            PresenceMeta(
+                format=session.format,
+                username=session.username,
+                status=status,
+            ),
+        )
+        out: dict = {}
+        if cid:
+            out["cid"] = cid
+            session.send(out)
+
+    # ----------------------------------------------------------------- rpc
+
+    async def _h_rpc(self, session, cid, body):
+        runtime = _require(self.c.runtime, "runtime")
+        rpc_id = (body.get("id") or "").lower()
+        fn = runtime.rpc(rpc_id)
+        if fn is None:
+            raise PipelineError(
+                f"RPC function not found: {rpc_id}",
+                ErrorCode.RUNTIME_FUNCTION_NOT_FOUND,
+            )
+        payload = body.get("payload", "")
+        try:
+            result = await _maybe_await(
+                fn(
+                    runtime.session_context(session),
+                    payload,
+                )
+            )
+        except Exception as e:
+            raise PipelineError(
+                str(e), ErrorCode.RUNTIME_FUNCTION_EXCEPTION
+            ) from e
+        out: dict = {"rpc": {"id": rpc_id, "payload": result or ""}}
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+
+class PipelineError(Exception):
+    def __init__(self, message: str, code: ErrorCode = ErrorCode.BAD_INPUT):
+        super().__init__(message)
+        self.code = code
+
+
+def _require(component, name: str):
+    if component is None:
+        raise PipelineError(f"{name} not available")
+    return component
+
+
+async def _maybe_await(value):
+    if asyncio.iscoroutine(value):
+        return await value
+    return value

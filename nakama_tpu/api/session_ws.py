@@ -1,0 +1,147 @@
+"""WebSocket session: per-connection read loop + buffered writer.
+
+Parity with the reference sessionWS (reference server/session_ws.go:77-523):
+a bounded outgoing queue drained by a writer task (overflow closes the
+session with "queue full"), a read loop dispatching each envelope into the
+pipeline, ping/pong liveness (delegated to the websockets library's
+ping_interval/ping_timeout), and a close path that untracks all presences,
+unfollows statuses, deregisters the session, and fires the session-end
+callback.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+from typing import Any, Callable
+
+from ..logger import Logger
+
+
+class WebSocketSession:
+    def __init__(
+        self,
+        ws: Any,
+        *,
+        user_id: str,
+        username: str,
+        vars: dict[str, str],
+        format: str,
+        expiry: float,
+        logger: Logger,
+        outgoing_queue_size: int = 64,
+        on_close: Callable[["WebSocketSession"], Any] | None = None,
+    ):
+        self._id = str(uuid.uuid4())
+        self.ws = ws
+        self._user_id = user_id
+        self._username = username
+        self.vars = vars
+        self._format = format
+        self.expiry = expiry
+        self.logger = logger.with_fields(
+            subsystem="session", sid=self._id, uid=user_id
+        )
+        self._outgoing: asyncio.Queue[dict | None] = asyncio.Queue(
+            maxsize=outgoing_queue_size
+        )
+        self._writer_task: asyncio.Task | None = None
+        self._closed = False
+        self._on_close = on_close
+
+    # ------------------------------------------------------------ identity
+
+    @property
+    def id(self) -> str:
+        return self._id
+
+    @property
+    def user_id(self) -> str:
+        return self._user_id
+
+    @property
+    def username(self) -> str:
+        return self._username
+
+    @property
+    def format(self) -> str:
+        return self._format
+
+    # ------------------------------------------------------------- sending
+
+    def send(self, envelope: dict) -> bool:
+        if self._closed:
+            return False
+        try:
+            self._outgoing.put_nowait(envelope)
+            return True
+        except asyncio.QueueFull:
+            self.logger.warn("session outgoing queue full, closing")
+            asyncio.get_running_loop().create_task(
+                self.close("outgoing queue full")
+            )
+            return False
+
+    async def _writer(self):
+        try:
+            while True:
+                envelope = await self._outgoing.get()
+                if envelope is None:
+                    return
+                await self.ws.send(json.dumps(envelope))
+        except Exception:
+            await self.close("write error")
+
+    # ------------------------------------------------------------ consume
+
+    async def consume(self, process: Callable[["WebSocketSession", dict], Any]):
+        """Blocking read loop (reference session_ws.go:173). `process` is the
+        pipeline entry; returning False from it closes the session."""
+        self._writer_task = asyncio.get_running_loop().create_task(
+            self._writer()
+        )
+        try:
+            async for raw in self.ws:
+                try:
+                    envelope = json.loads(raw)
+                    if not isinstance(envelope, dict):
+                        raise ValueError("not an object")
+                except ValueError:
+                    self.logger.debug("malformed envelope, closing")
+                    break
+                result = process(self, envelope)
+                if asyncio.iscoroutine(result):
+                    result = await result
+                if result is False:
+                    break
+        except Exception as e:
+            self.logger.debug("read loop ended", error=str(e))
+        finally:
+            await self.close("connection closed")
+
+    async def close(self, reason: str = ""):
+        if self._closed:
+            return
+        self._closed = True
+        if self._writer_task is not None:
+            # Let queued messages flush briefly, then stop the writer.
+            try:
+                self._outgoing.put_nowait(None)
+            except asyncio.QueueFull:
+                self._writer_task.cancel()
+            try:
+                await asyncio.wait_for(self._writer_task, timeout=1.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._writer_task.cancel()
+            self._writer_task = None
+        try:
+            await self.ws.close()
+        except Exception:
+            pass
+        if self._on_close is not None:
+            cb = self._on_close
+            self._on_close = None
+            result = cb(self)
+            if asyncio.iscoroutine(result):
+                await result

@@ -40,11 +40,13 @@ FLAG_HAS_MUST = 2
 FLAG_HAS_SHOULD = 4
 FLAG_NEVER = 8
 
-# Tie-break: equal-score candidates prefer longer-waiting tickets. The
-# penalty must stay below the smallest meaningful score gap; boosts are
-# user-supplied, so this is a documented resolution limit of the device path
-# (the native assembler re-sorts the surviving K exactly; must-only queries
-# have no score at all and order purely by wait).
+# Tie-break: equal-score candidates prefer longer-waiting tickets. The host
+# re-sorts each surviving candidate list exactly by (-score, created) before
+# assembly (tpu.py), so this epsilon only biases WHICH candidates survive the
+# top-K cutoff. It must stay below the smallest meaningful score gap; boosts
+# are user-supplied, so that cutoff bias is a documented resolution limit of
+# the device path. The kernel subtracts the pool's minimum live created_seq
+# before scaling, keeping the penalty small on long-lived servers.
 CREATED_EPS = np.float32(2.0**-24)
 
 
@@ -130,13 +132,20 @@ class PoolBuffer:
         size bucket instead of one per distinct update count."""
         if not self._pending_idx:
             return
-        u = len(self._pending_idx)
+        # Deduplicate by slot, last queued row wins: a remove + same-slot
+        # re-add within one interval must not leave scatter order (undefined
+        # for repeated indices) deciding which row survives.
+        latest: dict[int, dict[str, np.ndarray]] = {}
+        for slot, row in zip(self._pending_idx, self._pending_rows):
+            latest[slot] = row
+        u = len(latest)
         u_pad = 1 << (u - 1).bit_length()
+        idx_list = list(latest.keys())
+        rows = list(latest.values())
         idx = np.asarray(
-            self._pending_idx + [self._pending_idx[-1]] * (u_pad - u),
-            dtype=np.int32,
+            idx_list + [idx_list[-1]] * (u_pad - u), dtype=np.int32
         )
-        rows = self._pending_rows + [self._pending_rows[-1]] * (u_pad - u)
+        rows = rows + [rows[-1]] * (u_pad - u)
         stacked = {k: np.stack([r[k] for r in rows]) for k in self.device}
         self.device = _scatter(
             self.device, jnp.asarray(idx), jax.tree.map(jnp.asarray, stacked)
@@ -195,7 +204,7 @@ def _accepts(qrow: dict, fcol: dict, with_should: bool):
 
 def _block_eval(
     row, col, row_slot, col_base, rev: bool, with_should: bool,
-    with_embedding: bool,
+    with_embedding: bool, created_base=0,
 ):
     """Score one (row-block, column-block) pair → scores [Br, Bc]
     (−inf = ineligible)."""
@@ -228,7 +237,8 @@ def _block_eval(
     eligible = (
         ok & col_valid[:, None] & minmax_ok & party_ok & pool_ok & not_self
     )
-    score = score - col["created"][:, None].astype(jnp.float32) * CREATED_EPS
+    age = (col["created"][:, None] - created_base).astype(jnp.float32)
+    score = score - age * CREATED_EPS
     return jnp.where(eligible, score, NEG_INF).T  # [Br, Bc]
 
 
@@ -247,6 +257,7 @@ def scan_columns(
     with_should: bool,
     with_embedding: bool,
     varying_axis: str | None = None,
+    created_base=0,
 ):
     """Stream column blocks of `pool_view` against one row block, carrying a
     running top-k. Shared by the single-device kernel and the mesh-sharded
@@ -261,7 +272,7 @@ def scan_columns(
         }
         s = _block_eval(
             row, col, row_slots, col_base0 + cb * bc, rev, with_should,
-            with_embedding,
+            with_embedding, created_base,
         )
         s = jnp.where(row_valid[:, None], s, NEG_INF)
         idx = col_base0 + cb * bc + jnp.arange(bc, dtype=jnp.int32)
@@ -302,6 +313,7 @@ def topk_candidates(
     n_cols: int,
     with_should: bool,
     with_embedding: bool = False,
+    created_base: jnp.ndarray | int = 0,
 ):
     """For each active ticket, the top-k eligible candidates by
     (score desc, created asc): returns (scores [A_pad, k], slots [A_pad, k]
@@ -329,6 +341,7 @@ def topk_candidates(
             rev=rev,
             with_should=with_should,
             with_embedding=with_embedding,
+            created_base=created_base,
         )
         best_i = jnp.where(best_s > NEG_INF, best_i, -1)
         return best_s, best_i

@@ -171,6 +171,14 @@ class LocalMatchmaker:
         Reference Add: server/matchmaker.go:443-566."""
         if self._stopped:
             raise ErrNotAvailable("matchmaker stopped")
+        if not presences:
+            raise MatchmakerError("at least one presence required")
+        if count_multiple < 1:
+            raise MatchmakerError("count_multiple must be >= 1")
+        if min_count < 1 or max_count < min_count:
+            raise MatchmakerError("invalid min/max counts")
+        if len(presences) > max_count:
+            raise MatchmakerError("more presences than max_count")
         try:
             parsed = parse_query(query)
         except QueryError as e:
@@ -331,10 +339,11 @@ class LocalMatchmaker:
         self._update_gauges()
 
     def remove_all(self, node: str):
+        # Single-node build: every ticket belongs to this node.
+        if node != self.node:
+            return
         for ticket_id in list(self.tickets):
-            # Single-node build: every ticket belongs to this node.
-            if node == self.node:
-                self._unregister(ticket_id)
+            self._unregister(ticket_id)
         self._update_gauges()
 
     def remove(self, ticket_ids: list[str]):

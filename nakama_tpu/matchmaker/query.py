@@ -72,15 +72,26 @@ class Regexp:
 @dataclass(frozen=True)
 class Wildcard:
     field_name: str
-    pattern: str
+    pattern: str  # raw, backslash-escapes intact
     boost: float = 1.0
 
     def compiled(self):
-        rx = "".join(
-            ".*" if ch == "*" else "." if ch == "?" else re.escape(ch)
-            for ch in self.pattern
-        )
-        return re.compile(rx)
+        rx = []
+        i = 0
+        while i < len(self.pattern):
+            ch = self.pattern[i]
+            if ch == "\\" and i + 1 < len(self.pattern):
+                rx.append(re.escape(self.pattern[i + 1]))
+                i += 2
+                continue
+            if ch == "*":
+                rx.append(".*")
+            elif ch == "?":
+                rx.append(".")
+            else:
+                rx.append(re.escape(ch))
+            i += 1
+        return re.compile("".join(rx))
 
 
 @dataclass
@@ -220,12 +231,13 @@ def _parse_clause(tok: str):
         return occur, NumericEq(fld, float(value), boost)
 
     raw = value
-    unescaped = _unescape(raw)
     # Wildcard characters only count when unescaped.
     stripped = re.sub(r"\\.", "", raw)
     if "*" in stripped or "?" in stripped:
-        return occur, Wildcard(fld, unescaped, boost)
-    return occur, Term(fld, unescaped, boost)
+        # Keep the raw (escaped) pattern: Wildcard.compiled honours \* \?
+        # as literals.
+        return occur, Wildcard(fld, raw, boost)
+    return occur, Term(fld, _unescape(raw), boost)
 
 
 def parse_query(q: str) -> Query:

@@ -94,6 +94,9 @@ class TpuBackend:
         self.host_only: set[str] = set()
         self._should_tickets: set[str] = set()
         self._embedding_tickets: set[str] = set()
+        # Monotone lower bound on live created_seq: keeps the kernel's
+        # wait-time tie-break penalty small on long-lived servers.
+        self._created_base = 0
 
     # -------------------------------------------------- pool notifications
 
@@ -165,6 +168,8 @@ class TpuBackend:
             "flags": np.int32(flags),
         }
         slot = self.pool.add(ticket.ticket, row)
+        if len(self.pool) == 1:
+            self._created_base = ticket.created_seq
         if host_only:
             self.host_only.add(ticket.ticket)
         if cq is not None and cq.has_should:
@@ -254,8 +259,23 @@ class TpuBackend:
                 n_cols=n_cols,
                 with_should=bool(self._should_tickets),
                 with_embedding=bool(self._embedding_tickets),
+                created_base=np.int32(self._created_base),
             )
-            cand_np = np.ascontiguousarray(np.asarray(cand)[: len(slots)])
+            cand_np = np.asarray(cand)[: len(slots)]
+            scores_np = np.asarray(scores)[: len(slots)]
+            # Exact re-sort of each candidate list by (-score, created):
+            # the kernel's wait-time epsilon only biased the top-K cutoff.
+            created_of = self.meta["created"][np.maximum(cand_np, 0)]
+            created_of = np.where(
+                cand_np < 0, np.iinfo(np.int64).max, created_of
+            )
+            by_created = np.argsort(created_of, axis=1, kind="stable")
+            s2 = np.take_along_axis(scores_np, by_created, axis=1)
+            by_score = np.argsort(-s2, axis=1, kind="stable")
+            order = np.take_along_axis(by_created, by_score, axis=1)
+            cand_np = np.ascontiguousarray(
+                np.take_along_axis(cand_np, order, axis=1)
+            )
 
             slot_matches = native.assemble(
                 slots,
@@ -275,7 +295,15 @@ class TpuBackend:
                 tickets = [self.ticket_at[s] for s in match_slots]
                 if any(t is None for t in tickets):
                     continue
-                if rev_precision and not self._mutual_group(tickets):
+                # Host-side validation with the real query ASTs guards
+                # against 31-bit hash collisions and f32 bound rounding on
+                # device: one-sided (the searcher accepts every member,
+                # the oracle's non-rev guarantee) or fully mutual under
+                # rev_precision.
+                if rev_precision:
+                    if not self._mutual_group(tickets):
+                        continue
+                elif not self._searcher_accepts(tickets):
                     continue
                 entries: list[MatchmakerEntry] = []
                 for t in tickets:
@@ -295,6 +323,16 @@ class TpuBackend:
             matched.extend(host_matched)
 
         return matched, expired
+
+    def _searcher_accepts(self, tickets: list[MatchmakerTicket]) -> bool:
+        """The active (searching) ticket is last; its query must accept every
+        other member's document."""
+        from .query import matches
+
+        active = tickets[-1]
+        return all(
+            matches(active.parsed_query, t.document()) for t in tickets[:-1]
+        )
 
     def _mutual_group(self, tickets: list[MatchmakerTicket]) -> bool:
         """Combo-internal mutual validation with real query ASTs (the device

@@ -180,7 +180,10 @@ int32_t mm_assemble(
                  size <= active.max_count && h >= last_hit);
             if (!accept) continue;
 
-            std::vector<int32_t> match = *found;
+            // Trim operates on the combo IN PLACE (matching the oracle,
+            // process.py): if a post-trim check fails, later hits see the
+            // trimmed combo.
+            std::vector<int32_t>& match = combos[found_idx];
             int32_t rem = size % active.count_multiple;
             if (rem != 0) {
                 // Trim an exact-size group: drop the group with the smallest

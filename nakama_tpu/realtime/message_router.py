@@ -1,0 +1,73 @@
+"""Message router: envelope fan-out to presences and streams.
+
+Parity with the reference MessageRouter (reference
+server/message_router.go:33-110): send to explicit presence IDs or to every
+presence on a stream, honoring hidden presences for presence events, with a
+deferred-send queue the match loop flushes per tick.
+"""
+
+from __future__ import annotations
+
+from ..logger import Logger
+from ..metrics import Metrics
+from .session_registry import LocalSessionRegistry
+from .tracker import LocalTracker
+from .types import PresenceEvent, PresenceID, Stream
+
+
+class LocalMessageRouter:
+    def __init__(
+        self,
+        logger: Logger,
+        session_registry: LocalSessionRegistry,
+        tracker: LocalTracker,
+        metrics: Metrics | None = None,
+    ):
+        self.logger = logger.with_fields(subsystem="router")
+        self.sessions = session_registry
+        self.tracker = tracker
+        self.metrics = metrics
+        self._deferred: list[tuple[list[PresenceID], dict]] = []
+
+    def send_to_presence_ids(
+        self, presence_ids: list[PresenceID], envelope: dict
+    ):
+        for pid in presence_ids:
+            session = self.sessions.get(pid.session_id)
+            if session is None:
+                continue
+            if not session.send(envelope):
+                if self.metrics:
+                    self.metrics.outgoing_dropped.inc()
+
+    def send_to_stream(self, stream: Stream, envelope: dict):
+        self.send_to_presence_ids(
+            self.tracker.list_presence_ids_by_stream(stream), envelope
+        )
+
+    def send_deferred(self, presence_ids: list[PresenceID], envelope: dict):
+        """Queue for the end-of-tick flush (reference SendDeferred,
+        message_router.go:106)."""
+        self._deferred.append((presence_ids, envelope))
+
+    def flush_deferred(self):
+        deferred, self._deferred = self._deferred, []
+        for presence_ids, envelope in deferred:
+            self.send_to_presence_ids(presence_ids, envelope)
+
+    def route_presence_event(self, event: PresenceEvent):
+        """Client-facing stream presence events: joins/leaves on a stream are
+        delivered to the stream's remaining presences, hidden presences
+        excluded from the payload (reference tracker.go:1014-1096)."""
+        joins = [p.as_dict() for p in event.joins if not p.meta.hidden]
+        leaves = [p.as_dict() for p in event.leaves if not p.meta.hidden]
+        if not joins and not leaves:
+            return
+        envelope = {
+            "stream_presence_event": {
+                "stream": event.stream.as_dict(),
+                "joins": joins,
+                "leaves": leaves,
+            }
+        }
+        self.send_to_stream(event.stream, envelope)

@@ -1,0 +1,44 @@
+"""Shared test fakes, mirroring the reference's fixture hub
+(reference server/match_common_test.go:34-120: loggerForTest, fake router/
+session registry/tracker capturing sent envelopes)."""
+
+from __future__ import annotations
+
+from nakama_tpu.logger import test_logger as quiet_logger  # noqa: F401
+
+
+class FakeSession:
+    """Captures sent envelopes (reference DummySession, api_test.go:64)."""
+
+    def __init__(self, session_id: str, user_id: str, username: str = ""):
+        self._id = session_id
+        self._user_id = user_id
+        self._username = username or user_id
+        self.sent: list[dict] = []
+        self.closed = False
+        self.queue_full = False
+
+    @property
+    def id(self):
+        return self._id
+
+    @property
+    def user_id(self):
+        return self._user_id
+
+    @property
+    def username(self):
+        return self._username
+
+    @property
+    def format(self):
+        return "json"
+
+    def send(self, envelope: dict) -> bool:
+        if self.queue_full or self.closed:
+            return False
+        self.sent.append(envelope)
+        return True
+
+    async def close(self, reason: str = ""):
+        self.closed = True

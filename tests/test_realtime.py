@@ -1,0 +1,194 @@
+"""Realtime core tests: tracker double-index + event pump, router fan-out,
+status follows, stream manager validation, session/login caches."""
+
+import asyncio
+
+from fixtures import FakeSession, quiet_logger
+
+from nakama_tpu.realtime import (
+    LocalLoginAttemptCache,
+    LocalMessageRouter,
+    LocalSessionCache,
+    LocalSessionRegistry,
+    LocalStatusRegistry,
+    LocalStreamManager,
+    LocalTracker,
+    PresenceMeta,
+    Stream,
+    StreamMode,
+)
+
+
+def make_stack():
+    log = quiet_logger()
+    sessions = LocalSessionRegistry(log)
+    tracker = LocalTracker(log)
+    router = LocalMessageRouter(log, sessions, tracker)
+    tracker.set_event_router(router.route_presence_event)
+    return log, sessions, tracker, router
+
+
+async def test_track_untrack_and_double_index():
+    _, sessions, tracker, _ = make_stack()
+    s = Stream(StreamMode.CHANNEL, subject="room-a")
+    ok, new = tracker.track("sess1", s, "u1", PresenceMeta(username="alice"))
+    assert ok and new
+    ok, new = tracker.track("sess1", s, "u1", PresenceMeta(username="alice"))
+    assert ok and not new  # idempotent re-track
+    tracker.track("sess2", s, "u2", PresenceMeta(username="bob"))
+    assert tracker.count_by_stream(s) == 2
+    assert tracker.count() == 2
+    assert {p.user_id for p in tracker.list_by_stream(s)} == {"u1", "u2"}
+
+    tracker.untrack("sess1", s)
+    assert tracker.count_by_stream(s) == 1
+    tracker.untrack_all("sess2")
+    assert tracker.count_by_stream(s) == 0
+    assert tracker.count() == 0
+
+
+async def test_presence_events_fan_out_to_stream():
+    _, sessions, tracker, router = make_stack()
+    tracker.start()
+    try:
+        a, b = FakeSession("sa", "ua"), FakeSession("sb", "ub")
+        sessions.add(a)
+        sessions.add(b)
+        room = Stream(StreamMode.CHANNEL, subject="room")
+        tracker.track("sa", room, "ua", PresenceMeta(username="alice"))
+        await tracker.drain()
+        tracker.track("sb", room, "ub", PresenceMeta(username="bob"))
+        await tracker.drain()
+        # Alice sees bob's join (and her own initial join).
+        joins = [
+            e["stream_presence_event"]["joins"]
+            for e in a.sent
+            if "stream_presence_event" in e
+        ]
+        assert any(
+            j[0]["username"] == "bob" for j in joins if j
+        ), a.sent
+        # Hidden presences do not appear in events.
+        c = FakeSession("sc", "uc")
+        sessions.add(c)
+        tracker.track(
+            "sc", room, "uc", PresenceMeta(username="carol", hidden=True)
+        )
+        await tracker.drain()
+        assert not any(
+            j and j[0].get("username") == "carol"
+            for e in a.sent
+            for j in [e.get("stream_presence_event", {}).get("joins")]
+        )
+    finally:
+        tracker.stop()
+
+
+async def test_router_send_to_stream_and_deferred():
+    _, sessions, tracker, router = make_stack()
+    a, b = FakeSession("sa", "ua"), FakeSession("sb", "ub")
+    sessions.add(a)
+    sessions.add(b)
+    s = Stream(StreamMode.MATCH_RELAYED, subject="m1")
+    tracker.track("sa", s, "ua", PresenceMeta())
+    tracker.track("sb", s, "ub", PresenceMeta())
+    router.send_to_stream(s, {"match_data": {"op_code": 1}})
+    assert any("match_data" in e for e in a.sent)
+    assert any("match_data" in e for e in b.sent)
+
+    a.sent.clear()
+    router.send_deferred(
+        tracker.list_presence_ids_by_stream(s), {"match_data": {"op_code": 2}}
+    )
+    assert not a.sent  # not yet flushed
+    router.flush_deferred()
+    assert any(e["match_data"]["op_code"] == 2 for e in a.sent)
+
+
+async def test_status_registry_follow_unfollow():
+    log, sessions, tracker, router = make_stack()
+    status_reg = LocalStatusRegistry(log, sessions)
+    tracker.add_listener(StreamMode.STATUS, status_reg.status_listener())
+    tracker.start()
+    try:
+        watcher = FakeSession("sw", "uw")
+        sessions.add(watcher)
+        status_reg.follow("sw", {"u-target"})
+
+        target = FakeSession("st", "u-target")
+        sessions.add(target)
+        tracker.track(
+            "st",
+            Stream(StreamMode.STATUS, subject="u-target"),
+            "u-target",
+            PresenceMeta(username="tgt", status="Hello"),
+        )
+        await tracker.drain()
+        events = [e for e in watcher.sent if "status_presence_event" in e]
+        assert events and events[0]["status_presence_event"]["joins"][0][
+            "status"
+        ] == "Hello"
+
+        status_reg.unfollow("sw", {"u-target"})
+        watcher.sent.clear()
+        tracker.untrack("st", Stream(StreamMode.STATUS, subject="u-target"))
+        await tracker.drain()
+        assert not watcher.sent
+    finally:
+        tracker.stop()
+
+
+async def test_stream_manager_validates_session():
+    log, sessions, tracker, _ = make_stack()
+    sm = LocalStreamManager(log, sessions, tracker)
+    s = Stream(StreamMode.GROUP, subject="g1")
+    ok, _ = sm.user_join(s, "u1", "nope-session")
+    assert not ok
+    sess = FakeSession("s1", "u1")
+    sessions.add(sess)
+    ok, new = sm.user_join(s, "u1", "s1")
+    assert ok and new
+    ok, _ = sm.user_join(s, "u-wrong", "s1")  # session belongs to u1
+    assert not ok
+    sm.user_leave(s, "u1", "s1")
+    assert tracker.count_by_stream(s) == 0
+
+
+def test_session_cache_validity_and_ban():
+    import time
+
+    cache = LocalSessionCache(60, 3600)
+    cache.add("u1", time.time() + 60, "tok1", time.time() + 3600, "ref1")
+    assert cache.is_valid_session("u1", "tok1")
+    assert cache.is_valid_refresh("u1", "ref1")
+    assert not cache.is_valid_session("u1", "other")
+    cache.add("u1", time.time() - 1, "expired")
+    assert not cache.is_valid_session("u1", "expired")
+    cache.ban(["u1"])
+    assert not cache.is_valid_session("u1", "tok1")
+    cache.unban(["u1"])
+    assert not cache.is_valid_session("u1", "tok1")  # ban wiped tokens
+
+
+def test_login_attempt_lockout():
+    cache = LocalLoginAttemptCache()
+    assert cache.allow("alice", "1.2.3.4")
+    for _ in range(5):
+        cache.add_failure("alice", "1.2.3.4")
+    assert not cache.allow("alice", "1.2.3.4")
+    assert cache.allow("bob", "5.6.7.8")
+    cache.reset("alice")
+    assert cache.allow("alice", "9.9.9.9")
+
+
+async def test_session_registry_disconnect_and_single_session():
+    log, sessions, tracker, _ = make_stack()
+    cache = LocalSessionCache(60, 3600)
+    s1, s2 = FakeSession("s1", "u1"), FakeSession("s2", "u1")
+    sessions.add(s1)
+    sessions.add(s2)
+    await sessions.single_session(tracker, cache, "u1", keep_session_id="s2")
+    assert s1.closed and not s2.closed
+    assert await sessions.disconnect("s2")
+    assert s2.closed
+    assert not await sessions.disconnect("missing")

@@ -1,0 +1,248 @@
+"""Transport tests: JWT tokens, and the full end-to-end realtime slice —
+two real WebSocket clients authenticate, submit matchmaker tickets through
+the pipeline, and both receive matchmaker_matched (SURVEY.md §7 stages 1-5).
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+import websockets
+
+from fixtures import quiet_logger
+
+from nakama_tpu.api import session_token
+from nakama_tpu.api.matchmaker_events import make_matched_handler
+from nakama_tpu.api.pipeline import Components, Pipeline
+from nakama_tpu.api.socket import SocketAcceptor
+from nakama_tpu.config import Config
+from nakama_tpu.matchmaker import LocalMatchmaker
+from nakama_tpu.realtime import (
+    LocalMessageRouter,
+    LocalSessionCache,
+    LocalSessionRegistry,
+    LocalStatusRegistry,
+    LocalTracker,
+)
+
+
+def test_token_roundtrip_and_tamper():
+    token, claims = session_token.generate("k1", "u1", "alice", 60, {"a": "b"})
+    parsed = session_token.parse("k1", token)
+    assert parsed.user_id == "u1"
+    assert parsed.username == "alice"
+    assert parsed.vars == {"a": "b"}
+    assert parsed.token_id == claims.token_id
+
+    with pytest.raises(session_token.TokenError):
+        session_token.parse("wrong-key", token)
+    with pytest.raises(session_token.TokenError):
+        session_token.parse("k1", token[:-4] + "AAAA")
+    expired, _ = session_token.generate("k1", "u1", "alice", -1)
+    with pytest.raises(session_token.TokenError):
+        session_token.parse("k1", expired)
+
+
+class Harness:
+    """A live server on an ephemeral port with the realtime slice wired."""
+
+    def __init__(self):
+        self.config = Config()
+        log = quiet_logger()
+        self.sessions = LocalSessionRegistry(log)
+        self.session_cache = LocalSessionCache(60, 3600)
+        self.tracker = LocalTracker(log)
+        self.router = LocalMessageRouter(log, self.sessions, self.tracker)
+        self.tracker.set_event_router(self.router.route_presence_event)
+        self.status_registry = LocalStatusRegistry(log, self.sessions)
+        from nakama_tpu.realtime import StreamMode
+
+        self.tracker.add_listener(
+            StreamMode.STATUS, self.status_registry.status_listener()
+        )
+        self.matchmaker = LocalMatchmaker(log, self.config.matchmaker)
+        self.matchmaker.on_matched = make_matched_handler(
+            log,
+            self.router,
+            "n1",
+            self.config.session.encryption_key,
+        )
+        self.pipeline = Pipeline(
+            log,
+            Components(
+                config=self.config,
+                tracker=self.tracker,
+                router=self.router,
+                status_registry=self.status_registry,
+                matchmaker=self.matchmaker,
+            ),
+        )
+        self.acceptor = SocketAcceptor(
+            self.config,
+            log,
+            self.sessions,
+            self.session_cache,
+            self.tracker,
+            self.status_registry,
+            self.pipeline,
+        )
+        self.server = None
+        self.port = None
+
+    async def __aenter__(self):
+        self.tracker.start()
+        self.server = await websockets.serve(
+            self.acceptor.handle, "127.0.0.1", 0
+        )
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        self.tracker.stop()
+        self.server.close()
+        await self.server.wait_closed()
+
+    def token_for(self, user_id, username):
+        token, claims = session_token.generate(
+            self.config.session.encryption_key, user_id, username, 60
+        )
+        self.session_cache.add(
+            user_id, claims.expires_at, claims.token_id
+        )
+        return token
+
+    def url(self, token, **params):
+        extra = "".join(f"&{k}={v}" for k, v in params.items())
+        return f"ws://127.0.0.1:{self.port}/ws?token={token}{extra}"
+
+
+async def recv_until(ws, key, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        raw = await asyncio.wait_for(ws.recv(), timeout=max(0.01, remaining))
+        envelope = json.loads(raw)
+        if key in envelope:
+            return envelope
+
+
+async def test_ws_auth_rejected():
+    async with Harness() as h:
+        with pytest.raises(websockets.ConnectionClosed):
+            ws = await websockets.connect(h.url("garbage-token"))
+            await ws.recv()
+
+        # Valid JWT but not in the session cache (e.g. after logout).
+        token, _ = session_token.generate(
+            h.config.session.encryption_key, "u9", "eve", 60
+        )
+        with pytest.raises(websockets.ConnectionClosed):
+            ws = await websockets.connect(h.url(token))
+            await ws.recv()
+
+
+async def test_ws_ping_and_unknown_payload():
+    async with Harness() as h:
+        ws = await websockets.connect(h.url(h.token_for("u1", "alice")))
+        await ws.send(json.dumps({"cid": "1", "ping": {}}))
+        pong = await recv_until(ws, "pong")
+        assert pong["cid"] == "1"
+        await ws.send(json.dumps({"cid": "2", "bogus_variant": {}}))
+        err = await recv_until(ws, "error")
+        assert err["error"]["code"] == 1
+        await ws.close()
+
+
+async def test_end_to_end_matchmaking_over_ws():
+    async with Harness() as h:
+        a = await websockets.connect(h.url(h.token_for("u1", "alice")))
+        b = await websockets.connect(h.url(h.token_for("u2", "bob")))
+        for ws in (a, b):
+            await ws.send(
+                json.dumps(
+                    {
+                        "cid": "mm",
+                        "matchmaker_add": {
+                            "min_count": 2,
+                            "max_count": 2,
+                            "query": "+properties.mode:duel",
+                            "string_properties": {"mode": "duel"},
+                        },
+                    }
+                )
+            )
+            ticket = await recv_until(ws, "matchmaker_ticket")
+            assert ticket["matchmaker_ticket"]["ticket"]
+
+        h.matchmaker.process()
+
+        m_a = await recv_until(a, "matchmaker_matched")
+        m_b = await recv_until(b, "matchmaker_matched")
+        assert m_a["matchmaker_matched"]["token"] == m_b[
+            "matchmaker_matched"
+        ]["token"]
+        users = {
+            u["presence"]["username"]
+            for u in m_a["matchmaker_matched"]["users"]
+        }
+        assert users == {"alice", "bob"}
+        await a.close()
+        await b.close()
+
+
+async def test_matchmaker_add_validation_over_ws():
+    async with Harness() as h:
+        ws = await websockets.connect(h.url(h.token_for("u1", "alice")))
+        await ws.send(
+            json.dumps(
+                {"cid": "x", "matchmaker_add": {"min_count": 1, "max_count": 2}}
+            )
+        )
+        err = await recv_until(ws, "error")
+        assert "min count" in err["error"]["message"]
+        await ws.close()
+
+
+async def test_status_follow_update_over_ws():
+    async with Harness() as h:
+        watcher = await websockets.connect(h.url(h.token_for("u1", "alice")))
+        await watcher.send(
+            json.dumps({"cid": "f", "status_follow": {"user_ids": ["u2"]}})
+        )
+        snapshot = await recv_until(watcher, "status")
+        assert snapshot["status"]["presences"] == []
+
+        target = await websockets.connect(h.url(h.token_for("u2", "bob")))
+        ev = await recv_until(watcher, "status_presence_event")
+        assert ev["status_presence_event"]["joins"][0]["user_id"] == "u2"
+
+        await target.send(
+            json.dumps({"status_update": {"status": "In lobby"}})
+        )
+        ev = await recv_until(watcher, "status_presence_event")
+        assert any(
+            j["status"] == "In lobby"
+            for j in ev["status_presence_event"]["joins"]
+        )
+
+        await target.close()
+        ev = await recv_until(watcher, "status_presence_event")
+        assert ev["status_presence_event"]["leaves"]
+        await watcher.close()
+
+
+async def test_session_disconnect_cleans_up():
+    async with Harness() as h:
+        ws = await websockets.connect(h.url(h.token_for("u1", "alice")))
+        await ws.send(json.dumps({"ping": {}}))
+        await recv_until(ws, "pong")
+        assert len(h.sessions) == 1
+        assert h.tracker.count() >= 1
+        await ws.close()
+        for _ in range(100):
+            if len(h.sessions) == 0:
+                break
+            await asyncio.sleep(0.01)
+        assert len(h.sessions) == 0
+        assert h.tracker.count() == 0
