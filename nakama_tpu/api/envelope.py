@@ -76,6 +76,7 @@ RESPONSE_KEYS = frozenset(
         "party",
         "party_join_request",
         "party_leader",
+        "party_matchmaker_ticket",
         "party_presence_event",
         "party_data",
         "rpc",

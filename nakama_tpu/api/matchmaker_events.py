@@ -42,18 +42,27 @@ def make_matched_handler(
                         log.error("matchmaker matched hook error", error=str(e))
 
             if not match_id:
+                import uuid as _uuid
+
                 user_list = ",".join(
                     sorted(
                         f"{e.presence.user_id}:{e.presence.username}"
                         for e in entries
                     )
                 )
+                # The token names a relayed-match rendezvous id every matched
+                # client can join (reference matchmaker.go:392-399).
+                rendezvous = f"{_uuid.uuid4()}.{node}"
                 token, _ = session_token.generate(
                     encryption_key,
                     user_list,
                     "",
                     MATCH_TOKEN_EXPIRY_SEC,
-                    vars={"kind": "match_token", "node": node},
+                    vars={
+                        "kind": "match_token",
+                        "node": node,
+                        "mid": rendezvous,
+                    },
                 )
 
             users = [

@@ -34,6 +34,7 @@ class Components:
     party_registry: Any = None
     channels: Any = None  # channel core module facade
     runtime: Any = None
+    session_registry: Any = None
     metrics: Metrics | None = None
     extra: dict = field(default_factory=dict)
 
@@ -129,16 +130,8 @@ class Pipeline:
     def _h_matchmaker_add(self, session, cid, body):
         """Reference pipeline_matchmaker.go:23-101."""
         mm = _require(self.c.matchmaker, "matchmaker")
-        min_count = int(body.get("min_count", 0))
-        max_count = int(body.get("max_count", 0))
-        multiple = int(body.get("count_multiple", 1) or 1)
+        min_count, max_count, multiple = _validate_counts(body)
         query = body.get("query") or "*"
-        if min_count < 2:
-            raise PipelineError("invalid min count")
-        if max_count < min_count:
-            raise PipelineError("invalid max count")
-        if multiple < 1 or min_count % multiple or max_count % multiple:
-            raise PipelineError("invalid count multiple")
         from ..matchmaker import MatchmakerError, MatchmakerPresence
 
         presence = MatchmakerPresence(
@@ -241,6 +234,375 @@ class Pipeline:
             out["cid"] = cid
             session.send(out)
 
+    # --------------------------------------------------------------- match
+
+    def _presence_for(self, session, stream: Stream, hidden=False):
+        from ..realtime import Presence, PresenceID
+
+        return Presence(
+            id=PresenceID(self.c.config.name, session.id),
+            stream=stream,
+            user_id=session.user_id,
+            meta=PresenceMeta(
+                format=session.format,
+                username=session.username,
+                hidden=hidden,
+            ),
+        )
+
+    async def _h_match_create(self, session, cid, body):
+        """Client match creation (reference pipeline_match.go:37): with a
+        registered handler name → authoritative; bare → relayed."""
+        name = (body.get("name") or "").strip()
+        if name:
+            registry = _require(self.c.match_registry, "match registry")
+            from ..match import MatchError
+
+            try:
+                match_id = registry.create_match(name, body.get("params") or {})
+            except MatchError as e:
+                raise PipelineError(str(e)) from e
+            await self._join_authoritative(session, cid, match_id, {})
+            return
+        import uuid
+
+        match_id = f"{uuid.uuid4()}.{self.c.config.name}"
+        self._join_relayed(session, cid, match_id)
+
+    async def _h_match_join(self, session, cid, body):
+        metadata = body.get("metadata") or {}
+        match_id = body.get("match_id", "")
+        token = body.get("token", "")
+        if token:
+            from . import session_token
+
+            try:
+                claims = session_token.parse(
+                    self.c.config.session.encryption_key, token
+                )
+            except session_token.TokenError as e:
+                raise PipelineError(f"invalid match token: {e}") from e
+            if claims.vars.get("kind") != "match_token":
+                raise PipelineError("invalid match token")
+            match_id = claims.vars.get("mid", "")
+        if not match_id or "." not in match_id:
+            raise PipelineError("match id or token required")
+
+        registry = self.c.match_registry
+        handler = registry.get(match_id) if registry is not None else None
+        if handler is not None:
+            await self._join_authoritative(session, cid, match_id, metadata)
+        else:
+            self._join_relayed(session, cid, match_id)
+
+    async def _join_authoritative(self, session, cid, match_id, metadata):
+        registry = _require(self.c.match_registry, "match registry")
+        stream = Stream(StreamMode.MATCH_AUTHORITATIVE, subject=match_id)
+        presence = self._presence_for(session, stream)
+        allow, reason, handler = await registry.join_attempt(
+            match_id, presence, metadata
+        )
+        if not allow:
+            session.send(
+                error(
+                    ErrorCode.MATCH_JOIN_REJECTED,
+                    reason or "join rejected",
+                    cid,
+                )
+            )
+            return
+        existing = [
+            p.as_dict() for p in handler.presences.list()
+        ]
+        self.c.tracker.track(
+            session.id, stream, session.user_id, presence.meta
+        )
+        out = {
+            "match": {
+                "match_id": match_id,
+                "authoritative": True,
+                "label": handler.label,
+                "presences": existing,
+                "self": presence.as_dict(),
+            }
+        }
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _join_relayed(self, session, cid, match_id):
+        stream = Stream(StreamMode.MATCH_RELAYED, subject=match_id)
+        presence = self._presence_for(session, stream)
+        existing = [
+            p.as_dict()
+            for p in self.c.tracker.list_by_stream(stream)
+        ]
+        self.c.tracker.track(
+            session.id, stream, session.user_id, presence.meta
+        )
+        out = {
+            "match": {
+                "match_id": match_id,
+                "authoritative": False,
+                "presences": existing,
+                "self": presence.as_dict(),
+            }
+        }
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _h_match_leave(self, session, cid, body):
+        match_id = body.get("match_id", "")
+        if not match_id:
+            raise PipelineError("match id required")
+        for mode in (StreamMode.MATCH_RELAYED, StreamMode.MATCH_AUTHORITATIVE):
+            self.c.tracker.untrack(
+                session.id, Stream(mode, subject=match_id)
+            )
+        out: dict = {}
+        if cid:
+            out["cid"] = cid
+            session.send(out)
+
+    def _h_match_data_send(self, session, cid, body):
+        """Reference pipeline_match.go:338-366."""
+        match_id = body.get("match_id", "")
+        op_code = int(body.get("op_code", 0))
+        data = body.get("data", "")
+        registry = self.c.match_registry
+        handler = registry.get(match_id) if registry is not None else None
+        if handler is not None:
+            stream = Stream(StreamMode.MATCH_AUTHORITATIVE, subject=match_id)
+            presence = self.c.tracker.get_by_stream_user(stream, session.id)
+            if presence is None:
+                raise PipelineError("not in match")
+            registry.send_data(
+                match_id,
+                presence,
+                op_code,
+                data.encode() if isinstance(data, str) else data,
+                bool(body.get("reliable", True)),
+            )
+            return
+        stream = Stream(StreamMode.MATCH_RELAYED, subject=match_id)
+        sender = self.c.tracker.get_by_stream_user(stream, session.id)
+        if sender is None:
+            raise PipelineError("not in match")
+        envelope = {
+            "match_data": {
+                "match_id": match_id,
+                "presence": sender.as_dict(),
+                "op_code": op_code,
+                "data": data,
+            }
+        }
+        targets = [
+            p.id
+            for p in self.c.tracker.list_by_stream(stream)
+            if p.id.session_id != session.id
+        ]
+        self.c.router.send_to_presence_ids(targets, envelope)
+
+    # --------------------------------------------------------------- party
+
+    def _party(self, party_id: str):
+        registry = _require(self.c.party_registry, "party registry")
+        handler = registry.get(party_id)
+        if handler is None:
+            raise PipelineError("party not found")
+        return handler
+
+    def _h_party_create(self, session, cid, body):
+        """Reference pipeline_party.go partyCreate."""
+        registry = _require(self.c.party_registry, "party registry")
+        from ..match.party import PartyError
+
+        try:
+            handler = registry.create(
+                bool(body.get("open", True)),
+                int(body.get("max_size", 256) or 256),
+            )
+        except PartyError as e:
+            raise PipelineError(str(e)) from e
+        presence = self._presence_for(session, handler.stream)
+        self.c.tracker.track(
+            session.id, handler.stream, session.user_id, presence.meta
+        )
+        handler.on_joins([presence])
+        out = {"party": {**handler.as_dict(), "self": presence.as_dict()}}
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _h_party_join(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+
+        stream = handler.stream
+        presence = self._presence_for(session, stream)
+        try:
+            allowed = handler.request_join(presence)
+        except PartyError as e:
+            raise PipelineError(str(e)) from e
+        if allowed:
+            self.c.tracker.track(
+                session.id, stream, session.user_id, presence.meta
+            )
+            handler.on_joins([presence])
+            out = {"party": {**handler.as_dict(), "self": presence.as_dict()}}
+            if cid:
+                out["cid"] = cid
+            session.send(out)
+        elif cid:
+            session.send({"cid": cid})
+
+    def _h_party_leave(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        self.c.tracker.untrack(session.id, handler.stream)
+        if cid:
+            session.send({"cid": cid})
+
+    def _h_party_promote(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+
+        try:
+            handler.promote(session.id, body.get("presence") or {})
+        except PartyError as e:
+            raise PipelineError(str(e)) from e
+        if cid:
+            session.send({"cid": cid})
+
+    def _h_party_accept(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+
+        try:
+            presence = handler.accept(session.id, body.get("presence") or {})
+        except PartyError as e:
+            raise PipelineError(str(e)) from e
+        # Track on behalf of the accepted session (reference uses the
+        # stream manager for this, party_handler.go accept flow).
+        target = (
+            self.c.session_registry.get(presence.id.session_id)
+            if self.c.session_registry is not None
+            else None
+        )
+        if target is None:
+            raise PipelineError("accepted session gone")
+        self.c.tracker.track(
+            presence.id.session_id,
+            handler.stream,
+            presence.user_id,
+            presence.meta,
+        )
+        handler.on_joins([presence])
+        out = {
+            "party": {**handler.as_dict(), "self": presence.as_dict()}
+        }
+        target.send(out)
+        if cid:
+            session.send({"cid": cid})
+
+    def _h_party_remove(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+
+        try:
+            removed = handler.remove(session.id, body.get("presence") or {})
+        except PartyError as e:
+            raise PipelineError(str(e)) from e
+        if removed is not None:
+            self.c.tracker.untrack(removed.id.session_id, handler.stream)
+        if cid:
+            session.send({"cid": cid})
+
+    def _h_party_close(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+
+        try:
+            handler.close(session.id, self.c.tracker)
+        except PartyError as e:
+            raise PipelineError(str(e)) from e
+        self.c.party_registry.remove(handler.party_id)
+        if cid:
+            session.send({"cid": cid})
+
+    def _h_party_join_request_list(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        out = {
+            "party_join_request": {
+                "party_id": handler.party_id,
+                "presences": [
+                    p.as_dict() for p, _ in handler.join_requests.values()
+                ],
+            }
+        }
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _h_party_matchmaker_add(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+        from ..matchmaker import MatchmakerError
+
+        min_count, max_count, multiple = _validate_counts(body)
+        try:
+            ticket = handler.matchmaker_add(
+                session.id,
+                body.get("query") or "*",
+                min_count,
+                max_count,
+                multiple,
+                {
+                    k: str(v)
+                    for k, v in (body.get("string_properties") or {}).items()
+                },
+                {
+                    k: float(v)
+                    for k, v in (body.get("numeric_properties") or {}).items()
+                },
+            )
+        except (PartyError, MatchmakerError) as e:
+            raise PipelineError(str(e) or type(e).__name__) from e
+        out = {
+            "party_matchmaker_ticket": {
+                "party_id": handler.party_id,
+                "ticket": ticket,
+            }
+        }
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _h_party_matchmaker_remove(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+        from ..matchmaker import MatchmakerError
+
+        try:
+            handler.matchmaker_remove(session.id, body.get("ticket", ""))
+        except (PartyError, MatchmakerError) as e:
+            raise PipelineError(str(e) or type(e).__name__) from e
+        if cid:
+            session.send({"cid": cid})
+
+    def _h_party_data_send(self, session, cid, body):
+        handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+
+        try:
+            handler.data_send(
+                session.id,
+                int(body.get("op_code", 0)),
+                body.get("data", ""),
+            )
+        except PartyError as e:
+            raise PipelineError(str(e)) from e
+
     # ----------------------------------------------------------------- rpc
 
     async def _h_rpc(self, session, cid, body):
@@ -274,6 +636,21 @@ class PipelineError(Exception):
     def __init__(self, message: str, code: ErrorCode = ErrorCode.BAD_INPUT):
         super().__init__(message)
         self.code = code
+
+
+def _validate_counts(body: dict) -> tuple[int, int, int]:
+    """Matchmaker count validation shared by solo and party adds (reference
+    pipeline_matchmaker.go:27-71)."""
+    min_count = int(body.get("min_count", 0))
+    max_count = int(body.get("max_count", 0))
+    multiple = int(body.get("count_multiple", 1) or 1)
+    if min_count < 2:
+        raise PipelineError("invalid min count")
+    if max_count < min_count:
+        raise PipelineError("invalid max count")
+    if multiple < 1 or min_count % multiple or max_count % multiple:
+        raise PipelineError("invalid count multiple")
+    return min_count, max_count, multiple
 
 
 def _require(component, name: str):

@@ -39,6 +39,7 @@ class SocketAcceptor:
         status_registry: LocalStatusRegistry,
         pipeline,
         metrics: Metrics | None = None,
+        matchmaker=None,
         on_session_start=None,
         on_session_end=None,
     ):
@@ -50,6 +51,7 @@ class SocketAcceptor:
         self.status_registry = status_registry
         self.pipeline = pipeline
         self.metrics = metrics
+        self.matchmaker = matchmaker
         self.on_session_start = on_session_start
         self.on_session_end = on_session_end
 
@@ -91,6 +93,7 @@ class SocketAcceptor:
             outgoing_queue_size=self.config.socket.outgoing_queue_size,
             on_close=self._session_closed,
         )
+        session.token_id = claims.token_id  # for token invalidation
 
         if self.config.session.single_socket:
             await self.sessions.single_session(
@@ -118,6 +121,10 @@ class SocketAcceptor:
         await session.consume(self.pipeline.process)
 
     async def _session_closed(self, session: WebSocketSession):
+        if self.matchmaker is not None:
+            # A disconnected player must leave the matchmaking pool or peers
+            # get matched with a ghost (reference session close path).
+            self.matchmaker.remove_session_all(session.id)
         self.tracker.untrack_all(session.id)
         self.status_registry.unfollow_all(session.id)
         self.sessions.remove(session.id)

@@ -74,5 +74,7 @@ class LocalSessionRegistry:
         SingleSession, server/session_registry.go:128-151)."""
         for session in list(self._sessions.values()):
             if session.user_id == user_id and session.id != keep_session_id:
-                session_cache.remove_session(user_id, session.id)
+                token_id = getattr(session, "token_id", "")
+                if token_id:
+                    session_cache.remove_session(user_id, token_id)
                 await session.close("concurrent session")

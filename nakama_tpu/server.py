@@ -1,0 +1,208 @@
+"""Server assembly: component wiring in dependency order + lifecycle.
+
+Parity with the reference main() (reference main.go:64-282): metrics →
+session registry/caches → tracker → router → match registry → matchmaker →
+party registry → pipeline → socket acceptor — and graceful shutdown in
+reverse, draining authoritative matches first (main.go:209-240).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+import websockets
+
+from .api.matchmaker_events import make_matched_handler
+from .api.pipeline import Components, Pipeline
+from .api.socket import SocketAcceptor
+from .config import Config, parse_args
+from .logger import Logger, setup_logging
+from .match import LocalMatchRegistry, LocalPartyRegistry
+from .matchmaker import LocalMatchmaker
+from .metrics import Metrics
+from .realtime import (
+    LocalLoginAttemptCache,
+    LocalMessageRouter,
+    LocalSessionCache,
+    LocalSessionRegistry,
+    LocalStatusRegistry,
+    LocalStreamManager,
+    LocalTracker,
+    StreamMode,
+)
+
+
+class NakamaServer:
+    def __init__(
+        self,
+        config: Config,
+        logger: Logger | None = None,
+        matchmaker_backend=None,
+    ):
+        self.config = config
+        self.logger = logger or setup_logging(config.logger)
+        log = self.logger
+        node = config.name
+
+        self.metrics = Metrics(config.metrics.namespace)
+        self.session_registry = LocalSessionRegistry(log, self.metrics)
+        self.session_cache = LocalSessionCache(
+            config.session.token_expiry_sec,
+            config.session.refresh_token_expiry_sec,
+        )
+        self.login_attempt_cache = LocalLoginAttemptCache()
+        self.tracker = LocalTracker(
+            log, node, self.metrics, config.tracker.event_queue_size
+        )
+        self.router = LocalMessageRouter(
+            log, self.session_registry, self.tracker, self.metrics
+        )
+        self.tracker.set_event_router(self.router.route_presence_event)
+        self.status_registry = LocalStatusRegistry(log, self.session_registry)
+        self.tracker.add_listener(
+            StreamMode.STATUS, self.status_registry.status_listener()
+        )
+        self.stream_manager = LocalStreamManager(
+            log, self.session_registry, self.tracker
+        )
+        self.match_registry = LocalMatchRegistry(
+            log, config.match, self.router, node, self.metrics,
+            tracker=self.tracker,
+        )
+        self.tracker.add_listener(
+            StreamMode.MATCH_AUTHORITATIVE, self.match_registry.join_listener()
+        )
+        self.matchmaker = LocalMatchmaker(
+            log,
+            config.matchmaker,
+            self.metrics,
+            node,
+            backend=matchmaker_backend,
+        )
+        self.runtime = None
+        self.matchmaker.on_matched = make_matched_handler(
+            log,
+            self.router,
+            node,
+            config.session.encryption_key,
+            runtime=None,
+        )
+        self.party_registry = LocalPartyRegistry(
+            log, self.tracker, self.router, self.matchmaker, node
+        )
+        self.tracker.add_listener(
+            StreamMode.PARTY, self.party_registry.join_listener()
+        )
+        self.pipeline = Pipeline(
+            log,
+            Components(
+                config=config,
+                tracker=self.tracker,
+                router=self.router,
+                status_registry=self.status_registry,
+                matchmaker=self.matchmaker,
+                match_registry=self.match_registry,
+                party_registry=self.party_registry,
+                session_registry=self.session_registry,
+                metrics=self.metrics,
+            ),
+        )
+        self.acceptor = SocketAcceptor(
+            config,
+            log,
+            self.session_registry,
+            self.session_cache,
+            self.tracker,
+            self.status_registry,
+            self.pipeline,
+            self.metrics,
+            matchmaker=self.matchmaker,
+        )
+        self._ws_server = None
+
+    def attach_runtime(self, runtime):
+        """Wire the extensibility runtime into the pipeline, the matchmaker
+        matched hook, and the match registry (reference NewRuntime wiring,
+        main.go:155-160)."""
+        self.runtime = runtime
+        self.pipeline.c.runtime = runtime
+        self.matchmaker.on_matched = make_matched_handler(
+            self.logger,
+            self.router,
+            self.config.name,
+            self.config.session.encryption_key,
+            runtime=runtime,
+        )
+        override = getattr(runtime, "matchmaker_override", None)
+        if override is not None and override() is not None:
+            self.matchmaker.override_fn = override()
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, port: int | None = None):
+        self.tracker.start()
+        self.matchmaker.start()
+        self._ws_server = await websockets.serve(
+            self.acceptor.handle,
+            self.config.socket.address or "127.0.0.1",
+            self.config.socket.port if port is None else port,
+            max_size=self.config.socket.max_message_size_bytes * 64,
+        )
+        self.port = self._ws_server.sockets[0].getsockname()[1]
+        self.logger.info("server listening", port=self.port)
+
+    async def stop(self, grace_seconds: int | None = None):
+        """Reverse-order shutdown draining matches first (main.go:209-240)."""
+        grace = (
+            self.config.shutdown_grace_sec
+            if grace_seconds is None
+            else grace_seconds
+        )
+        if self._ws_server is not None:
+            self._ws_server.close()
+            await self._ws_server.wait_closed()
+        await self.match_registry.stop_all(grace)
+        self.matchmaker.stop()
+        for session in self.session_registry.all():
+            await session.close("server shutting down")
+        self.tracker.stop()
+        self.logger.info("server stopped")
+
+    def issue_session(self, user_id: str, username: str) -> str:
+        """Create a session token + register it with the cache (the auth
+        core's tail; exposed for tests and the console)."""
+        from .api import session_token
+
+        token, claims = session_token.generate(
+            self.config.session.encryption_key,
+            user_id,
+            username,
+            self.config.session.token_expiry_sec,
+        )
+        self.session_cache.add(user_id, claims.expires_at, claims.token_id)
+        return token
+
+
+async def _amain(config: Config):
+    server = NakamaServer(config)
+    await server.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.stop()
+
+
+def main(argv: list[str] | None = None):
+    import sys
+
+    config = parse_args(argv if argv is not None else sys.argv[1:])
+    for warning in config.check():
+        print(f"config warning: {warning}")
+    asyncio.run(_amain(config))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,202 @@
+"""Match engine tests: handler tick loop, join attempts, label listing,
+signals, presence lifecycle — with a scripted MatchCore (mirrors the
+reference's testMatch core, match_common_test.go:83)."""
+
+import asyncio
+import json
+
+from fixtures import FakeSession, quiet_logger
+
+from nakama_tpu.config import MatchConfig
+from nakama_tpu.match import LocalMatchRegistry, MatchError
+from nakama_tpu.realtime import (
+    LocalMessageRouter,
+    LocalSessionRegistry,
+    LocalTracker,
+    Presence,
+    PresenceID,
+    PresenceMeta,
+    Stream,
+    StreamMode,
+)
+
+
+class ScriptedMatch:
+    """Counts ticks, echoes data, rejects users named 'badguy', ends when
+    state['end'] set via signal."""
+
+    def match_init(self, ctx, params):
+        return (
+            {"ticks": 0, "echoed": 0, "end": False},
+            params.get("tick_rate", 30),
+            json.dumps({"mode": params.get("mode", "demo"), "skill": 7}),
+        )
+
+    def match_join_attempt(self, ctx, dispatcher, tick, state, presence, md):
+        if presence.meta.username == "badguy":
+            return state, False, "banned"
+        return state, True, ""
+
+    def match_join(self, ctx, dispatcher, tick, state, presences):
+        return state
+
+    def match_leave(self, ctx, dispatcher, tick, state, presences):
+        return state
+
+    def match_loop(self, ctx, dispatcher, tick, state, messages):
+        state["ticks"] += 1
+        for m in messages:
+            state["echoed"] += 1
+            dispatcher.broadcast_message(m.op_code + 1, m.data, sender=m.sender)
+        if state["end"]:
+            return None
+        return state
+
+    def match_terminate(self, ctx, dispatcher, tick, state, grace):
+        state["terminated"] = True
+        return state
+
+    def match_signal(self, ctx, dispatcher, tick, state, data):
+        if data == "end":
+            state["end"] = True
+        return state, f"ack:{data}"
+
+
+def make_engine():
+    log = quiet_logger()
+    sessions = LocalSessionRegistry(log)
+    tracker = LocalTracker(log)
+    router = LocalMessageRouter(log, sessions, tracker)
+    registry = LocalMatchRegistry(log, MatchConfig(), router, node="n1")
+    registry.register("scripted", ScriptedMatch)
+    tracker.add_listener(
+        StreamMode.MATCH_AUTHORITATIVE, registry.join_listener()
+    )
+    return log, sessions, tracker, router, registry
+
+
+def presence(session_id, user_id, username, match_id):
+    return Presence(
+        id=PresenceID("n1", session_id),
+        stream=Stream(StreamMode.MATCH_AUTHORITATIVE, subject=match_id),
+        user_id=user_id,
+        meta=PresenceMeta(username=username),
+    )
+
+
+async def test_match_create_tick_and_signal():
+    _, _, tracker, _, registry = make_engine()
+    match_id = registry.create_match("scripted", {"tick_rate": 60})
+    assert len(registry) == 1
+    await asyncio.sleep(0.1)
+    handler = registry.get(match_id)
+    assert handler.tick >= 3  # ticked several times at 60Hz
+
+    reply = await registry.signal(match_id, "hello")
+    assert reply == "ack:hello"
+    reply = await registry.signal(match_id, "end")
+    await asyncio.sleep(0.1)
+    assert registry.get(match_id) is None  # loop returned None → removed
+
+
+async def test_unknown_handler_rejected():
+    _, _, _, _, registry = make_engine()
+    try:
+        registry.create_match("nope", {})
+        raise AssertionError("expected MatchError")
+    except MatchError:
+        pass
+
+
+async def test_join_attempt_flow_and_data():
+    _, sessions, tracker, router, registry = make_engine()
+    tracker.start()
+    try:
+        match_id = registry.create_match("scripted", {"tick_rate": 60})
+        alice = FakeSession("sa", "ua", "alice")
+        sessions.add(alice)
+
+        p = presence("sa", "ua", "alice", match_id)
+        allow, reason, handler = await registry.join_attempt(match_id, p)
+        assert allow and reason == ""
+        # Rejected join.
+        bad = presence("sb", "ub", "badguy", match_id)
+        allow, reason, _ = await registry.join_attempt(match_id, bad)
+        assert not allow and reason == "banned"
+
+        # Completed stream join flows through the tracker listener.
+        tracker.track("sa", p.stream, "ua", p.meta)
+        await tracker.drain()
+        await asyncio.sleep(0.05)
+        assert len(handler.presences) == 1
+
+        # Client data → loop echoes with op_code+1 to the match stream.
+        assert registry.send_data(match_id, p, 7, b"payload")
+        await asyncio.sleep(0.1)
+        echoes = [
+            e for e in alice.sent
+            if "match_data" in e and e["match_data"]["op_code"] == 8
+        ]
+        assert echoes and echoes[0]["match_data"]["data"] == "payload"
+
+        # Leave via untrack.
+        tracker.untrack("sa", p.stream)
+        await tracker.drain()
+        await asyncio.sleep(0.05)
+        assert len(handler.presences) == 0
+    finally:
+        tracker.stop()
+        await registry.stop_all(0)
+
+
+async def test_join_marker_expiry_kicks_reserved_slot():
+    _, _, tracker, _, registry = make_engine()
+    cfg = registry.config
+    cfg.join_marker_deadline_ms = 50
+    match_id = registry.create_match("scripted", {"tick_rate": 60})
+    handler = registry.get(match_id)
+    p = presence("sx", "ux", "x", match_id)
+    allow, _, _ = await registry.join_attempt(match_id, p)
+    assert allow
+    assert len(handler.join_markers) == 1
+    await asyncio.sleep(0.3)  # never completes the stream join
+    assert len(handler.join_markers) == 0
+    await registry.stop_all(0)
+
+
+async def test_list_matches_with_label_query():
+    _, _, _, _, registry = make_engine()
+    registry.create_match("scripted", {"mode": "ranked", "tick_rate": 1})
+    registry.create_match("scripted", {"mode": "casual", "tick_rate": 1})
+    out = registry.list_matches(query="+label.mode:ranked")
+    assert len(out) == 1
+    assert json.loads(out[0]["label"])["mode"] == "ranked"
+    out = registry.list_matches(query="+label.skill:>=5")
+    assert len(out) == 2
+    out = registry.list_matches(limit=1)
+    assert len(out) == 1
+    await registry.stop_all(0)
+
+
+async def test_stop_all_terminates_gracefully():
+    _, _, _, _, registry = make_engine()
+    match_id = registry.create_match("scripted", {"tick_rate": 30})
+    handler = registry.get(match_id)
+    await registry.stop_all(0)
+    assert handler.state.get("terminated") is True
+    assert len(registry) == 0
+
+
+async def test_empty_match_auto_termination():
+    log = quiet_logger()
+    sessions = LocalSessionRegistry(log)
+    tracker = LocalTracker(log)
+    router = LocalMessageRouter(log, sessions, tracker)
+    cfg = MatchConfig(max_empty_sec=1)
+    registry = LocalMatchRegistry(log, cfg, router, node="n1")
+    registry.register("scripted", ScriptedMatch)
+    match_id = registry.create_match("scripted", {"tick_rate": 30})
+    # join markers block auto-termination; with none, ~1s of empty ticks
+    # ends the match.
+    await asyncio.sleep(1.5)
+    assert registry.get(match_id) is None

@@ -1,0 +1,284 @@
+"""Party lifecycle + full-server tests over real sockets: create/join/leader
+election/promotion/data relay/party matchmaking, driven through
+NakamaServer — the production wiring."""
+
+import asyncio
+import json
+import time
+
+import pytest
+import websockets
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.server import NakamaServer
+
+
+class Client:
+    def __init__(self, ws):
+        self.ws = ws
+        self.inbox: list[dict] = []
+
+    @classmethod
+    async def connect(cls, server, user_id, username):
+        token = server.issue_session(user_id, username)
+        ws = await websockets.connect(
+            f"ws://127.0.0.1:{server.port}/ws?token={token}"
+        )
+        return cls(ws)
+
+    async def send(self, envelope):
+        await self.ws.send(json.dumps(envelope))
+
+    async def recv(self, key, timeout=5.0):
+        for i, e in enumerate(self.inbox):
+            if key in e:
+                return self.inbox.pop(i)
+        deadline = time.monotonic() + timeout
+        while True:
+            raw = await asyncio.wait_for(
+                self.ws.recv(), timeout=max(0.01, deadline - time.monotonic())
+            )
+            e = json.loads(raw)
+            if key in e:
+                return e
+            self.inbox.append(e)
+
+    async def close(self):
+        await self.ws.close()
+
+
+async def make_server():
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    return server
+
+
+async def test_party_full_lifecycle():
+    server = await make_server()
+    try:
+        alice = await Client.connect(server, "ua", "alice")
+        bob = await Client.connect(server, "ub", "bob")
+        carol = await Client.connect(server, "uc", "carol")
+
+        # Alice creates an open party and is the leader.
+        await alice.send({"cid": "1", "party_create": {"open": True, "max_size": 3}})
+        party = (await alice.recv("party"))["party"]
+        pid = party["party_id"]
+        assert party["leader"]["user_id"] == "ua"
+
+        # Bob joins the open party directly.
+        await bob.send({"cid": "2", "party_join": {"party_id": pid}})
+        bp = (await bob.recv("party"))["party"]
+        assert {p["user_id"] for p in bp["presences"]} >= {"ua"}
+
+        # Carol joins; data relay reaches everyone.
+        await carol.send({"cid": "3", "party_join": {"party_id": pid}})
+        await carol.recv("party")
+        await asyncio.sleep(0.05)
+        await alice.send(
+            {"party_data_send": {"party_id": pid, "op_code": 5, "data": "hi"}}
+        )
+        for c in (bob, carol):
+            data = (await c.recv("party_data"))["party_data"]
+            assert data["op_code"] == 5 and data["data"] == "hi"
+
+        # Non-leader cannot promote.
+        await bob.send(
+            {
+                "cid": "4",
+                "party_promote": {
+                    "party_id": pid,
+                    "presence": {"session_id": "whatever"},
+                },
+            }
+        )
+        err = await bob.recv("error")
+        assert "leader" in err["error"]["message"]
+
+        # Party matchmaking: leader submits one ticket for all 3 members.
+        await alice.send(
+            {
+                "cid": "5",
+                "party_matchmaker_add": {
+                    "party_id": pid,
+                    "min_count": 6,
+                    "max_count": 6,
+                    "query": "*",
+                },
+            }
+        )
+        ticket = await alice.recv("party_matchmaker_ticket")
+        assert ticket["party_matchmaker_ticket"]["ticket"]
+        assert len(server.matchmaker) == 1
+        t = next(iter(server.matchmaker.tickets.values()))
+        assert t.count == 3 and t.party_id == pid
+
+        # Alice (leader) disconnects → leadership promotes, tickets cancel.
+        await alice.close()
+        ev = await bob.recv("party_leader", timeout=5)
+        assert ev["party_leader"]["presence"]["user_id"] in ("ub", "uc")
+        for _ in range(100):
+            if len(server.matchmaker) == 0:
+                break
+            await asyncio.sleep(0.01)
+        assert len(server.matchmaker) == 0
+
+        await bob.close()
+        await carol.close()
+    finally:
+        await server.stop(0)
+
+
+async def test_closed_party_join_request_accept():
+    server = await make_server()
+    try:
+        alice = await Client.connect(server, "ua", "alice")
+        bob = await Client.connect(server, "ub", "bob")
+
+        await alice.send(
+            {"cid": "1", "party_create": {"open": False, "max_size": 2}}
+        )
+        pid = (await alice.recv("party"))["party"]["party_id"]
+
+        await bob.send({"cid": "2", "party_join": {"party_id": pid}})
+        req = await alice.recv("party_join_request")
+        joiner = req["party_join_request"]["presences"][0]
+        assert joiner["user_id"] == "ub"
+
+        await alice.send(
+            {
+                "cid": "3",
+                "party_accept": {"party_id": pid, "presence": joiner},
+            }
+        )
+        party = (await bob.recv("party"))["party"]
+        assert {p["user_id"] for p in party["presences"]} == {"ua", "ub"}
+        await alice.close()
+        await bob.close()
+    finally:
+        await server.stop(0)
+
+
+async def test_authoritative_match_over_socket():
+    server = await make_server()
+    try:
+        from tests_matches import EchoMatch  # registered factory below
+    except ImportError:
+        class EchoMatch:
+            def match_init(self, ctx, params):
+                return {"n": 0}, 30, "echo"
+
+            def match_join_attempt(self, ctx, d, tick, state, presence, md):
+                return state, True, ""
+
+            def match_join(self, ctx, d, tick, state, presences):
+                return state
+
+            def match_leave(self, ctx, d, tick, state, presences):
+                return state
+
+            def match_loop(self, ctx, d, tick, state, messages):
+                for m in messages:
+                    d.broadcast_message(m.op_code, m.data.upper())
+                return state
+
+            def match_terminate(self, ctx, d, tick, state, grace):
+                return state
+
+            def match_signal(self, ctx, d, tick, state, data):
+                return state, ""
+
+    server.match_registry.register("echo", EchoMatch)
+    try:
+        alice = await Client.connect(server, "ua", "alice")
+        await alice.send({"cid": "1", "match_create": {"name": "echo"}})
+        match = (await alice.recv("match"))["match"]
+        assert match["authoritative"] is True
+        mid = match["match_id"]
+        await asyncio.sleep(0.1)  # let the stream join complete
+
+        await alice.send(
+            {
+                "match_data_send": {
+                    "match_id": mid,
+                    "op_code": 9,
+                    "data": "whisper",
+                }
+            }
+        )
+        echo = await alice.recv("match_data")
+        assert echo["match_data"]["data"] == "WHISPER"
+        assert echo["match_data"]["op_code"] == 9
+        await alice.close()
+    finally:
+        await server.stop(0)
+
+
+async def test_relayed_match_over_socket():
+    server = await make_server()
+    try:
+        alice = await Client.connect(server, "ua", "alice")
+        bob = await Client.connect(server, "ub", "bob")
+        await alice.send({"cid": "1", "match_create": {}})
+        match = (await alice.recv("match"))["match"]
+        assert match["authoritative"] is False
+        mid = match["match_id"]
+
+        await bob.send({"cid": "2", "match_join": {"match_id": mid}})
+        bmatch = (await bob.recv("match"))["match"]
+        assert {p["user_id"] for p in bmatch["presences"]} == {"ua"}
+
+        await bob.send(
+            {"match_data_send": {"match_id": mid, "op_code": 3, "data": "yo"}}
+        )
+        got = await alice.recv("match_data")
+        assert got["match_data"]["data"] == "yo"
+        assert got["match_data"]["presence"]["user_id"] == "ub"
+
+        # Sender must be in the match to send.
+        eve = await Client.connect(server, "ue", "eve")
+        await eve.send(
+            {
+                "cid": "x",
+                "match_data_send": {"match_id": mid, "op_code": 1, "data": "h"},
+            }
+        )
+        err = await eve.recv("error")
+        assert "not in match" in err["error"]["message"]
+        for c in (alice, bob, eve):
+            await c.close()
+    finally:
+        await server.stop(0)
+
+
+async def test_matchmaker_token_joins_relayed_match():
+    server = await make_server()
+    try:
+        a = await Client.connect(server, "u1", "p1")
+        b = await Client.connect(server, "u2", "p2")
+        for c in (a, b):
+            await c.send(
+                {
+                    "cid": "m",
+                    "matchmaker_add": {"min_count": 2, "max_count": 2},
+                }
+            )
+            await c.recv("matchmaker_ticket")
+        server.matchmaker.process()
+        tok_a = (await a.recv("matchmaker_matched"))["matchmaker_matched"]["token"]
+        tok_b = (await b.recv("matchmaker_matched"))["matchmaker_matched"]["token"]
+
+        await a.send({"cid": "j", "match_join": {"token": tok_a}})
+        m_a = (await a.recv("match"))["match"]
+        await b.send({"cid": "j", "match_join": {"token": tok_b}})
+        m_b = (await b.recv("match"))["match"]
+        assert m_a["match_id"] == m_b["match_id"]
+        assert {p["user_id"] for p in m_b["presences"]} == {"u1"}
+        await a.close()
+        await b.close()
+    finally:
+        await server.stop(0)
