@@ -20,6 +20,17 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Some images preload jax at interpreter startup (before conftest runs), so
+# the env vars above may be read too late. Force the same settings through the
+# live config API; this works as long as no backend has been initialised yet.
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:  # backend already up (e.g. single-process rerun) — tests will skip
+    pass
+
 import pytest
 
 
