@@ -532,12 +532,16 @@ class Pipeline:
 
     def _h_party_join_request_list(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
+        from ..match.party import PartyError
+
+        try:
+            pending = handler.join_request_list(session.id)
+        except PartyError as e:
+            raise PipelineError(str(e)) from e
         out = {
             "party_join_request": {
                 "party_id": handler.party_id,
-                "presences": [
-                    p.as_dict() for p, _ in handler.join_requests.values()
-                ],
+                "presences": [p.as_dict() for p in pending],
             }
         }
         if cid:

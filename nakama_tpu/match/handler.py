@@ -22,6 +22,13 @@ from .core import MatchDispatcher, MatchMessage
 from .presence import JoinMarkerList, MatchPresenceList
 
 
+def _resolve(fut: asyncio.Future, value):
+    """Resolve a waiter future; the caller's wait_for may have already
+    cancelled it (timeout), which must not crash the match task."""
+    if not fut.done():
+        fut.set_result(value)
+
+
 class MatchHandler:
     def __init__(
         self,
@@ -56,6 +63,7 @@ class MatchHandler:
             maxsize=config.call_queue_size
         )
         self._deferred: list[tuple[list[PresenceID] | None, dict]] = []
+        self._pending_kicks: list[Presence] = []
         self._empty_ticks = 0
 
         self.ctx = {
@@ -134,11 +142,19 @@ class MatchHandler:
             self.logger.error("match_loop error, ending match", error=str(e))
             new_state = None
         self.tick += 1
-        self._flush_deferred()
         if new_state is None:
+            # Still honour kicks from the final tick so match_leave and
+            # stream untrack run before the match dies.
+            self._drain_kicks()
+            self._flush_deferred()
             self.stopped = True
             return False
         self.state = new_state
+        # Kicks requested by the core during match_loop apply only now, so
+        # match_leave's state return isn't clobbered by match_loop's
+        # (reference defers dispatcher kicks to end of tick).
+        self._drain_kicks()
+        self._flush_deferred()
 
         # Empty-match auto-termination (match_handler.go:160).
         if self.config.max_empty_sec > 0:
@@ -169,6 +185,7 @@ class MatchHandler:
                 if state is not None:
                     self.state = state
             finally:
+                self._drain_kicks()
                 self._flush_deferred()
                 self.stopped = True
 
@@ -201,7 +218,7 @@ class MatchHandler:
 
         async def call():
             if self.presences.contains(presence.id):
-                fut.set_result((True, ""))
+                _resolve(fut, (True, ""))
                 return
             try:
                 state, allow, reason = self.core.match_join_attempt(
@@ -213,14 +230,17 @@ class MatchHandler:
                     metadata,
                 )
             except Exception as e:
-                fut.set_result((False, str(e)))
+                self._drain_kicks()
+                self._flush_deferred()
+                _resolve(fut, (False, str(e)))
                 return
             if state is not None:
                 self.state = state
             if allow:
                 self.join_markers.add(presence.id.session_id, self.tick)
+            self._drain_kicks()
             self._flush_deferred()
-            fut.set_result((bool(allow), reason or ""))
+            _resolve(fut, (bool(allow), reason or ""))
 
         if not await self._enqueue_call(call):
             return False, "match call queue full"
@@ -244,6 +264,7 @@ class MatchHandler:
                     self.state = state
             except Exception as e:
                 self.logger.error("match_join error", error=str(e))
+            self._drain_kicks()
             self._flush_deferred()
 
         await self._enqueue_call(call)
@@ -283,9 +304,11 @@ class MatchHandler:
                 )
                 if state is not None:
                     self.state = state
-                fut.set_result(reply or "")
+                _resolve(fut, reply or "")
             except Exception as e:
-                fut.set_exception(e)
+                if not fut.done():
+                    fut.set_exception(e)
+            self._drain_kicks()
             self._flush_deferred()
 
         if not await self._enqueue_call(call):
@@ -330,7 +353,15 @@ class MatchHandler:
         self._deferred.append((targets, envelope))
 
     def kick(self, presences: list[Presence]):
-        self._apply_leaves(presences)
+        # Deferred until the in-flight core callback returns and its state is
+        # committed; applying immediately would run match_leave re-entrantly
+        # with stale state.
+        self._pending_kicks.extend(presences)
+
+    def _drain_kicks(self):
+        while self._pending_kicks:
+            batch, self._pending_kicks = self._pending_kicks, []
+            self._apply_leaves(batch)
 
     def update_label(self, label: str):
         self.label = label

@@ -120,6 +120,12 @@ class PartyHandler:
                 return p
         raise PartyError("not a member")
 
+    def join_request_list(self, leader_session: str) -> list[Presence]:
+        """Leader-only list of pending join requests (reference
+        party_handler.go:519-527)."""
+        self._require_leader(leader_session)
+        return [p for p, _ in self.join_requests.values()]
+
     def promote(self, leader_session: str, presence_dict: dict) -> Presence:
         self._require_leader(leader_session)
         sid = presence_dict.get("session_id", "")

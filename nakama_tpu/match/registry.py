@@ -210,10 +210,17 @@ class LocalMatchRegistry:
         return handler.get_state_json(), handler.tick, len(handler.presences)
 
     async def stop_all(self, grace_seconds: int = 0):
-        """Graceful drain (reference Stop, main.go:209-240)."""
+        """Graceful drain (reference Stop, main.go:209-240). All matches
+        share one grace window, draining concurrently like the reference."""
+        import asyncio
+
         self._stopped = True
-        for handler in list(self._handlers.values()):
-            await handler.stop(grace_seconds)
+        handlers = list(self._handlers.values())
+        if handlers:
+            await asyncio.gather(
+                *(h.stop(grace_seconds) for h in handlers),
+                return_exceptions=True,
+            )
 
     # ------------------------------------------------------------ listeners
 
@@ -221,6 +228,15 @@ class LocalMatchRegistry:
         """Tracker listener for MATCH_AUTHORITATIVE streams (reference
         main.go:153): completed stream joins/leaves feed the match task."""
         import asyncio
+
+        # asyncio keeps only weak refs to tasks; retain them until done or a
+        # delivery task can be collected mid-flight and silently dropped.
+        tasks: set[asyncio.Task] = set()
+
+        def _spawn(loop, coro):
+            task = loop.create_task(coro)
+            tasks.add(task)
+            task.add_done_callback(tasks.discard)
 
         def on_event(joins: list[Presence], leaves: list[Presence]):
             by_match_j: dict[str, list[Presence]] = {}
@@ -231,9 +247,9 @@ class LocalMatchRegistry:
                 by_match_l.setdefault(p.stream.subject, []).append(p)
             loop = asyncio.get_running_loop()
             for match_id, ps in by_match_j.items():
-                loop.create_task(self.join(match_id, ps))
+                _spawn(loop, self.join(match_id, ps))
             for match_id, ps in by_match_l.items():
-                loop.create_task(self.leave(match_id, ps))
+                _spawn(loop, self.leave(match_id, ps))
 
         return on_event
 

@@ -147,7 +147,7 @@ class NakamaServer:
             self.acceptor.handle,
             self.config.socket.address or "127.0.0.1",
             self.config.socket.port if port is None else port,
-            max_size=self.config.socket.max_message_size_bytes * 64,
+            max_size=self.config.socket.max_message_size_bytes,
         )
         self.port = self._ws_server.sockets[0].getsockname()[1]
         self.logger.info("server listening", port=self.port)

@@ -165,32 +165,29 @@ async def test_closed_party_join_request_accept():
 
 async def test_authoritative_match_over_socket():
     server = await make_server()
-    try:
-        from tests_matches import EchoMatch  # registered factory below
-    except ImportError:
-        class EchoMatch:
-            def match_init(self, ctx, params):
-                return {"n": 0}, 30, "echo"
+    class EchoMatch:
+        def match_init(self, ctx, params):
+            return {"n": 0}, 30, "echo"
 
-            def match_join_attempt(self, ctx, d, tick, state, presence, md):
-                return state, True, ""
+        def match_join_attempt(self, ctx, d, tick, state, presence, md):
+            return state, True, ""
 
-            def match_join(self, ctx, d, tick, state, presences):
-                return state
+        def match_join(self, ctx, d, tick, state, presences):
+            return state
 
-            def match_leave(self, ctx, d, tick, state, presences):
-                return state
+        def match_leave(self, ctx, d, tick, state, presences):
+            return state
 
-            def match_loop(self, ctx, d, tick, state, messages):
-                for m in messages:
-                    d.broadcast_message(m.op_code, m.data.upper())
-                return state
+        def match_loop(self, ctx, d, tick, state, messages):
+            for m in messages:
+                d.broadcast_message(m.op_code, m.data.upper())
+            return state
 
-            def match_terminate(self, ctx, d, tick, state, grace):
-                return state
+        def match_terminate(self, ctx, d, tick, state, grace):
+            return state
 
-            def match_signal(self, ctx, d, tick, state, data):
-                return state, ""
+        def match_signal(self, ctx, d, tick, state, data):
+            return state, ""
 
     server.match_registry.register("echo", EchoMatch)
     try:
