@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ..logger import Logger
+from ..match.party import PartyError
 from ..metrics import Metrics
 from ..realtime import PresenceMeta, Stream, StreamMode
 from .envelope import REQUEST_KEYS, ErrorCode, error, message_key
@@ -416,7 +417,6 @@ class Pipeline:
     def _h_party_create(self, session, cid, body):
         """Reference pipeline_party.go partyCreate."""
         registry = _require(self.c.party_registry, "party registry")
-        from ..match.party import PartyError
 
         try:
             handler = registry.create(
@@ -437,7 +437,6 @@ class Pipeline:
 
     def _h_party_join(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
 
         stream = handler.stream
         presence = self._presence_for(session, stream)
@@ -465,7 +464,6 @@ class Pipeline:
 
     def _h_party_promote(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
 
         try:
             handler.promote(session.id, body.get("presence") or {})
@@ -476,7 +474,6 @@ class Pipeline:
 
     def _h_party_accept(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
 
         try:
             presence = handler.accept(session.id, body.get("presence") or {})
@@ -507,7 +504,6 @@ class Pipeline:
 
     def _h_party_remove(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
 
         try:
             removed = handler.remove(session.id, body.get("presence") or {})
@@ -520,7 +516,6 @@ class Pipeline:
 
     def _h_party_close(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
 
         try:
             handler.close(session.id, self.c.tracker)
@@ -532,7 +527,6 @@ class Pipeline:
 
     def _h_party_join_request_list(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
 
         try:
             pending = handler.join_request_list(session.id)
@@ -550,7 +544,6 @@ class Pipeline:
 
     def _h_party_matchmaker_add(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
         from ..matchmaker import MatchmakerError
 
         min_count, max_count, multiple = _validate_counts(body)
@@ -584,7 +577,6 @@ class Pipeline:
 
     def _h_party_matchmaker_remove(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
         from ..matchmaker import MatchmakerError
 
         try:
@@ -596,7 +588,6 @@ class Pipeline:
 
     def _h_party_data_send(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
-        from ..match.party import PartyError
 
         try:
             handler.data_send(

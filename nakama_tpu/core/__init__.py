@@ -1,0 +1,36 @@
+"""Core domain services (L1).
+
+Plain async functions/classes `(db, ...) -> result` mirroring the
+reference's `server/core_*.go` services (SURVEY.md §2.2): storage, account,
+authenticate, wallet, friend, group, channel, notification, leaderboard,
+tournament, purchase. Each module documents the reference behaviors it
+re-implements with file:line citations.
+"""
+
+from .storage import (
+    StorageError,
+    StorageObject,
+    StorageOpDelete,
+    StorageOpRead,
+    StorageOpWrite,
+    StoragePermissionError,
+    StorageVersionError,
+    storage_delete_objects,
+    storage_list_objects,
+    storage_read_objects,
+    storage_write_objects,
+)
+
+__all__ = [
+    "StorageError",
+    "StorageObject",
+    "StorageOpDelete",
+    "StorageOpRead",
+    "StorageOpWrite",
+    "StoragePermissionError",
+    "StorageVersionError",
+    "storage_delete_objects",
+    "storage_list_objects",
+    "storage_read_objects",
+    "storage_write_objects",
+]

@@ -308,6 +308,13 @@ class MatchHandler:
             except Exception as e:
                 if not fut.done():
                     fut.set_exception(e)
+                else:
+                    # Waiter already timed out — don't lose the core error.
+                    self.logger.error(
+                        "match signal error after timeout",
+                        match_id=self.match_id,
+                        error=str(e),
+                    )
             self._drain_kicks()
             self._flush_deferred()
 

@@ -217,10 +217,17 @@ class LocalMatchRegistry:
         self._stopped = True
         handlers = list(self._handlers.values())
         if handlers:
-            await asyncio.gather(
+            results = await asyncio.gather(
                 *(h.stop(grace_seconds) for h in handlers),
                 return_exceptions=True,
             )
+            for handler, result in zip(handlers, results):
+                if isinstance(result, BaseException):
+                    self.logger.error(
+                        "match drain error",
+                        match_id=handler.match_id,
+                        error=str(result),
+                    )
 
     # ------------------------------------------------------------ listeners
 

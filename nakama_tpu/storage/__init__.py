@@ -1,0 +1,17 @@
+"""Persistence layer (L0): async SQLite database + embedded migrations.
+
+The reference backs everything onto PostgreSQL/CockroachDB via pgx
+(reference server/db.go:35, migrate/sql/*.sql — 10 migrations, 17 tables).
+Our L0 is an embedded SQLite engine behind the same async seam the rest of
+the framework uses, so a Postgres driver can be swapped in later without
+touching the core domain services (SURVEY.md §7 stage 7).
+"""
+
+from .db import Database, DatabaseError, UniqueViolationError, migrate_status
+
+__all__ = [
+    "Database",
+    "DatabaseError",
+    "UniqueViolationError",
+    "migrate_status",
+]

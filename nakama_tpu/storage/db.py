@@ -1,0 +1,225 @@
+"""Async database engine over SQLite.
+
+Plays the role of the reference's connection manager (reference
+server/db.go:35 DbConnect: multi-DSN connect, ping, version probe) for an
+embedded engine. SQLite calls are synchronous, so every operation runs on a
+single dedicated executor thread — the SQLite connection lives on that
+thread only — and transactions hold an asyncio lock for their duration,
+giving the same serialised-writer discipline the reference gets from
+Postgres transactions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import sqlite3
+from typing import Any, Iterable
+
+from .migrations import MIGRATIONS
+
+
+class DatabaseError(Exception):
+    pass
+
+
+class Database:
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="nakama-db"
+        )
+        self._conn: sqlite3.Connection | None = None
+        self._lock = asyncio.Lock()
+        # Task currently holding an open Transaction; Database-level ops
+        # issued by that same task join the transaction instead of
+        # deadlocking on the non-reentrant lock.
+        self._tx_owner: asyncio.Task | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def connect(self) -> None:
+        def _open():
+            conn = sqlite3.connect(self.path, check_same_thread=False)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA foreign_keys=ON")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            return conn
+
+        self._conn = await self._run(_open)
+        await self.migrate()
+
+    async def close(self) -> None:
+        # Take the lock so we never close under an open transaction.
+        async with self._lock:
+            if self._conn is not None:
+                conn = self._conn
+                self._conn = None
+                await self._run(conn.close)
+        self._executor.shutdown(wait=False)
+
+    async def migrate(self) -> list[str]:
+        """Apply embedded migrations in order; returns names applied
+        (reference migrate.StartupCheck, main.go:133)."""
+
+        def _migrate(conn: sqlite3.Connection) -> list[str]:
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS migration_info ("
+                " version INTEGER PRIMARY KEY, name TEXT NOT NULL,"
+                " applied_at REAL NOT NULL DEFAULT (strftime('%s','now')))"
+            )
+            done = {
+                row[0]
+                for row in conn.execute("SELECT version FROM migration_info")
+            }
+            applied = []
+            for version, name, statements in MIGRATIONS:
+                if version in done:
+                    continue
+                for stmt in statements:
+                    conn.execute(stmt)
+                conn.execute(
+                    "INSERT INTO migration_info (version, name) VALUES (?, ?)",
+                    (version, name),
+                )
+                applied.append(name)
+            conn.commit()
+            return applied
+
+        return await self._with_conn(_migrate)
+
+    # ----------------------------------------------------------- operations
+
+    async def execute(self, sql: str, params: Iterable[Any] = ()) -> int:
+        """Run one statement; returns affected row count. Inside this task's
+        open ``tx()`` it joins the transaction; otherwise auto-commits."""
+        in_tx = asyncio.current_task() is self._tx_owner
+
+        def _exec(conn: sqlite3.Connection) -> int:
+            cur = conn.execute(sql, tuple(params))
+            if not in_tx:
+                conn.commit()
+            return cur.rowcount
+
+        if in_tx:
+            return await self._with_conn(_exec)
+        async with self._lock:
+            return await self._with_conn(_exec)
+
+    async def fetch_all(
+        self, sql: str, params: Iterable[Any] = ()
+    ) -> list[dict]:
+        def _fetch(conn: sqlite3.Connection) -> list[dict]:
+            return [
+                dict(row)
+                for row in conn.execute(sql, tuple(params)).fetchall()
+            ]
+
+        if asyncio.current_task() is self._tx_owner:
+            return await self._with_conn(_fetch)
+        # Lock so reads never observe another task's open transaction on the
+        # shared connection.
+        async with self._lock:
+            return await self._with_conn(_fetch)
+
+    async def fetch_one(
+        self, sql: str, params: Iterable[Any] = ()
+    ) -> dict | None:
+        def _fetch(conn: sqlite3.Connection):
+            row = conn.execute(sql, tuple(params)).fetchone()
+            return dict(row) if row is not None else None
+
+        if asyncio.current_task() is self._tx_owner:
+            return await self._with_conn(_fetch)
+        async with self._lock:
+            return await self._with_conn(_fetch)
+
+    def tx(self) -> "Transaction":
+        """``async with db.tx() as tx:`` — serialised read-modify-write
+        transaction (the reference's ExecuteInTx, server/db.go)."""
+        return Transaction(self)
+
+    # ------------------------------------------------------------ internals
+
+    async def _run(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, fn, *args)
+
+    async def _with_conn(self, fn):
+        if self._conn is None:
+            raise DatabaseError("database not connected")
+        try:
+            return await self._run(fn, self._conn)
+        except sqlite3.IntegrityError as e:
+            raise UniqueViolationError(str(e)) from e
+        except sqlite3.Error as e:
+            raise DatabaseError(str(e)) from e
+
+
+class UniqueViolationError(DatabaseError):
+    """Constraint conflict — the reference maps pg unique_violation the same
+    way (server/db_error.go)."""
+
+
+class Transaction:
+    """Holds the database lock for its scope; all statements inside are one
+    SQLite transaction, rolled back on exception."""
+
+    def __init__(self, db: Database):
+        self._db = db
+
+    async def __aenter__(self) -> "Transaction":
+        await self._db._lock.acquire()
+        try:
+            await self._db._with_conn(
+                lambda conn: conn.execute("BEGIN IMMEDIATE")
+            )
+        except BaseException:
+            self._db._lock.release()
+            raise
+        self._db._tx_owner = asyncio.current_task()
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> bool:
+        try:
+            if exc_type is None:
+                await self._db._with_conn(lambda conn: conn.commit())
+            else:
+                await self._db._with_conn(lambda conn: conn.rollback())
+        finally:
+            self._db._tx_owner = None
+            self._db._lock.release()
+        return False
+
+    async def execute(self, sql: str, params: Iterable[Any] = ()) -> int:
+        def _exec(conn: sqlite3.Connection) -> int:
+            return conn.execute(sql, tuple(params)).rowcount
+
+        return await self._db._with_conn(_exec)
+
+    async def fetch_all(
+        self, sql: str, params: Iterable[Any] = ()
+    ) -> list[dict]:
+        def _fetch(conn: sqlite3.Connection) -> list[dict]:
+            return [
+                dict(row) for row in conn.execute(sql, tuple(params)).fetchall()
+            ]
+
+        return await self._db._with_conn(_fetch)
+
+    async def fetch_one(
+        self, sql: str, params: Iterable[Any] = ()
+    ) -> dict | None:
+        def _fetch(conn: sqlite3.Connection):
+            row = conn.execute(sql, tuple(params)).fetchone()
+            return dict(row) if row is not None else None
+
+        return await self._db._with_conn(_fetch)
+
+
+async def migrate_status(db: Database) -> list[dict]:
+    """`nakama migrate status` equivalent (reference migrate/migrate.go)."""
+    return await db.fetch_all(
+        "SELECT version, name, applied_at FROM migration_info ORDER BY version"
+    )

@@ -1,0 +1,267 @@
+"""OCC object-store matrix (mirrors reference server/core_storage_test.go
+scenarios: unconditional/if-absent/conditional writes, permission
+enforcement, batch atomicity, cursored listing)."""
+
+import json
+
+import pytest
+
+from nakama_tpu.core import (
+    StorageOpDelete,
+    StorageOpRead,
+    StorageOpWrite,
+    StoragePermissionError,
+    StorageVersionError,
+    storage_delete_objects,
+    storage_list_objects,
+    storage_read_objects,
+    storage_write_objects,
+)
+from nakama_tpu.core.storage import StorageError
+from nakama_tpu.storage import Database
+
+
+async def make_db():
+    db = Database(":memory:")
+    await db.connect()
+    return db
+
+
+SYSTEM = None  # system/runtime caller
+U1 = "user-1"
+U2 = "user-2"
+
+
+async def test_write_new_then_read():
+    db = await make_db()
+    acks = await storage_write_objects(
+        db, SYSTEM, [StorageOpWrite("c", "k", U1, '{"a": 1}')]
+    )
+    assert len(acks) == 1 and acks[0].version
+    objs = await storage_read_objects(db, SYSTEM, [StorageOpRead("c", "k", U1)])
+    assert len(objs) == 1
+    assert json.loads(objs[0].value) == {"a": 1}
+    assert objs[0].version == acks[0].version
+    await db.close()
+
+
+async def test_write_same_value_is_idempotent_version():
+    db = await make_db()
+    a1 = await storage_write_objects(
+        db, SYSTEM, [StorageOpWrite("c", "k", U1, '{"a": 1}')]
+    )
+    a2 = await storage_write_objects(
+        db, SYSTEM, [StorageOpWrite("c", "k", U1, '{"a": 1}')]
+    )
+    assert a1[0].version == a2[0].version
+    await db.close()
+
+
+async def test_if_not_exists_star():
+    db = await make_db()
+    await storage_write_objects(
+        db, SYSTEM, [StorageOpWrite("c", "k", U1, '{"a": 1}', version="*")]
+    )
+    # Second * write over an existing object must fail OCC.
+    with pytest.raises(StorageVersionError):
+        await storage_write_objects(
+            db, SYSTEM, [StorageOpWrite("c", "k", U1, '{"a": 2}', version="*")]
+        )
+    await db.close()
+
+
+async def test_conditional_update():
+    db = await make_db()
+    acks = await storage_write_objects(
+        db, SYSTEM, [StorageOpWrite("c", "k", U1, '{"a": 1}')]
+    )
+    # Correct version: accepted.
+    acks2 = await storage_write_objects(
+        db,
+        SYSTEM,
+        [StorageOpWrite("c", "k", U1, '{"a": 2}', version=acks[0].version)],
+    )
+    assert acks2[0].version != acks[0].version
+    # Stale version: rejected.
+    with pytest.raises(StorageVersionError):
+        await storage_write_objects(
+            db,
+            SYSTEM,
+            [StorageOpWrite("c", "k", U1, '{"a": 3}', version=acks[0].version)],
+        )
+    await db.close()
+
+
+async def test_conditional_write_on_missing_object_fails():
+    db = await make_db()
+    with pytest.raises(StorageVersionError):
+        await storage_write_objects(
+            db,
+            SYSTEM,
+            [StorageOpWrite("c", "nope", U1, '{"a": 1}', version="deadbeef")],
+        )
+    await db.close()
+
+
+async def test_client_cannot_write_others_objects():
+    db = await make_db()
+    with pytest.raises(StoragePermissionError):
+        await storage_write_objects(
+            db, U2, [StorageOpWrite("c", "k", U1, '{"a": 1}')]
+        )
+    with pytest.raises(StoragePermissionError):
+        await storage_write_objects(
+            db, U1, [StorageOpWrite("c", "k", "", '{"a": 1}')]
+        )
+    await db.close()
+
+
+async def test_write_permission_0_blocks_client_rewrite():
+    db = await make_db()
+    await storage_write_objects(
+        db,
+        SYSTEM,
+        [StorageOpWrite("c", "k", U1, '{"a": 1}', permission_write=0)],
+    )
+    with pytest.raises(StoragePermissionError):
+        await storage_write_objects(
+            db, U1, [StorageOpWrite("c", "k", U1, '{"a": 2}')]
+        )
+    # System still can.
+    await storage_write_objects(
+        db, SYSTEM, [StorageOpWrite("c", "k", U1, '{"a": 2}')]
+    )
+    await db.close()
+
+
+async def test_read_permissions():
+    db = await make_db()
+    await storage_write_objects(
+        db,
+        SYSTEM,
+        [
+            StorageOpWrite("c", "private", U1, '{"v": 0}', permission_read=0),
+            StorageOpWrite("c", "owner", U1, '{"v": 1}', permission_read=1),
+            StorageOpWrite("c", "public", U1, '{"v": 2}', permission_read=2),
+        ],
+    )
+    ops = [
+        StorageOpRead("c", "private", U1),
+        StorageOpRead("c", "owner", U1),
+        StorageOpRead("c", "public", U1),
+    ]
+    assert len(await storage_read_objects(db, SYSTEM, ops)) == 3
+    got_owner = await storage_read_objects(db, U1, ops)
+    assert sorted(o.key for o in got_owner) == ["owner", "public"]
+    got_other = await storage_read_objects(db, U2, ops)
+    assert [o.key for o in got_other] == ["public"]
+    await db.close()
+
+
+async def test_batch_write_is_atomic():
+    db = await make_db()
+    acks = await storage_write_objects(
+        db, SYSTEM, [StorageOpWrite("c", "k1", U1, '{"a": 1}')]
+    )
+    with pytest.raises(StorageVersionError):
+        await storage_write_objects(
+            db,
+            SYSTEM,
+            [
+                StorageOpWrite("c", "k2", U1, '{"b": 1}'),
+                StorageOpWrite("c", "k1", U1, '{"a": 2}', version="stale"),
+            ],
+        )
+    # k2 must have been rolled back.
+    objs = await storage_read_objects(
+        db, SYSTEM, [StorageOpRead("c", "k2", U1)]
+    )
+    assert objs == []
+    # k1 unchanged.
+    objs = await storage_read_objects(
+        db, SYSTEM, [StorageOpRead("c", "k1", U1)]
+    )
+    assert objs[0].version == acks[0].version
+    await db.close()
+
+
+async def test_invalid_json_rejected():
+    db = await make_db()
+    with pytest.raises(StorageError):
+        await storage_write_objects(
+            db, SYSTEM, [StorageOpWrite("c", "k", U1, "not json")]
+        )
+    with pytest.raises(StorageError):
+        await storage_write_objects(
+            db, SYSTEM, [StorageOpWrite("c", "k", U1, "[1,2]")]
+        )
+    await db.close()
+
+
+async def test_delete_conditional():
+    db = await make_db()
+    acks = await storage_write_objects(
+        db, SYSTEM, [StorageOpWrite("c", "k", U1, '{"a": 1}')]
+    )
+    with pytest.raises(StorageVersionError):
+        await storage_delete_objects(
+            db, SYSTEM, [StorageOpDelete("c", "k", U1, version="stale")]
+        )
+    await storage_delete_objects(
+        db, SYSTEM, [StorageOpDelete("c", "k", U1, version=acks[0].version)]
+    )
+    assert (
+        await storage_read_objects(db, SYSTEM, [StorageOpRead("c", "k", U1)])
+        == []
+    )
+    # Deleting a missing object without a version is a no-op.
+    await storage_delete_objects(db, SYSTEM, [StorageOpDelete("c", "k", U1)])
+    await db.close()
+
+
+async def test_list_with_cursor():
+    db = await make_db()
+    ops = [
+        StorageOpWrite("inv", f"item-{i:03d}", U1, json.dumps({"i": i}))
+        for i in range(25)
+    ]
+    await storage_write_objects(db, SYSTEM, ops)
+    page1, cur1 = await storage_list_objects(db, SYSTEM, "inv", limit=10)
+    assert len(page1) == 10 and cur1
+    page2, cur2 = await storage_list_objects(
+        db, SYSTEM, "inv", limit=10, cursor=cur1
+    )
+    assert len(page2) == 10 and cur2
+    page3, cur3 = await storage_list_objects(
+        db, SYSTEM, "inv", limit=10, cursor=cur2
+    )
+    assert len(page3) == 5 and cur3 == ""
+    keys = [o.key for o in page1 + page2 + page3]
+    assert keys == sorted(keys) and len(set(keys)) == 25
+    await db.close()
+
+
+async def test_list_permission_filtering():
+    db = await make_db()
+    await storage_write_objects(
+        db,
+        SYSTEM,
+        [
+            StorageOpWrite("c", "mine", U1, '{"v": 1}', permission_read=1),
+            StorageOpWrite("c", "pub", U2, '{"v": 2}', permission_read=2),
+            StorageOpWrite("c", "hidden", U2, '{"v": 3}', permission_read=1),
+        ],
+    )
+    objs, _ = await storage_list_objects(db, U1, "c")
+    assert sorted(o.key for o in objs) == ["mine", "pub"]
+    await db.close()
+
+
+async def test_migrations_are_idempotent():
+    db = await make_db()
+    assert await db.migrate() == []  # second run applies nothing
+    from nakama_tpu.storage import migrate_status
+
+    status = await migrate_status(db)
+    assert len(status) >= 5
+    await db.close()
