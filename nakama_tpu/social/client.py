@@ -1,0 +1,134 @@
+"""Social provider verification clients.
+
+The reference's `social.Client` (reference social/social.go) verifies
+provider tokens and fetches profiles over HTTPS: Facebook Graph +
+Limited-Login JWKS (:225), Facebook Instant signed payloads (:310), Google
+id_token (:370), GameCenter signature check (:520), Steam web API (:610),
+Apple Sign-In JWKS (:700). Here the same surface is an async interface;
+`HttpSocialClient` is the production seam (raises without egress), and
+`StubSocialClient` provides deterministic offline verification:
+- Facebook Instant payloads are HMAC-SHA256 checked against the configured
+  app secret exactly like the reference (social.go:310-368);
+- GameCenter inputs are shape-validated;
+- bearer-style tokens map to profiles via a programmable table.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+from dataclasses import dataclass
+
+
+class SocialError(Exception):
+    pass
+
+
+@dataclass
+class SocialProfile:
+    provider: str
+    id: str
+    username: str = ""
+    display_name: str = ""
+    avatar_url: str = ""
+    lang_tag: str = "en"
+    email: str = ""
+
+
+class SocialClient:
+    """Interface; one async verify method per provider."""
+
+    async def verify_facebook(self, token: str) -> SocialProfile:
+        raise SocialError("facebook verification unavailable")
+
+    async def verify_facebook_instant(
+        self, app_secret: str, signed_player_info: str
+    ) -> SocialProfile:
+        """Signed-payload check, no network needed (reference
+        social.go:310-368): payload is `sig.b64(json)` where sig =
+        HMAC-SHA256(app_secret, payload-part)."""
+        try:
+            sig_part, payload_part = signed_player_info.split(".", 1)
+        except ValueError as e:
+            raise SocialError("malformed signed player info") from e
+        expected = base64.urlsafe_b64decode(sig_part + "=" * (-len(sig_part) % 4))
+        actual = hmac.new(
+            app_secret.encode(), payload_part.encode(), hashlib.sha256
+        ).digest()
+        if not hmac.compare_digest(expected, actual):
+            raise SocialError("signed player info signature mismatch")
+        data = json.loads(
+            base64.urlsafe_b64decode(payload_part + "=" * (-len(payload_part) % 4))
+        )
+        player_id = data.get("player_id", "")
+        if not player_id:
+            raise SocialError("missing player_id")
+        return SocialProfile(provider="facebook_instant_game", id=player_id)
+
+    async def verify_google(self, token: str) -> SocialProfile:
+        raise SocialError("google verification unavailable")
+
+    async def verify_gamecenter(
+        self,
+        player_id: str,
+        bundle_id: str,
+        timestamp: int,
+        salt: str,
+        signature: str,
+        public_key_url: str,
+    ) -> SocialProfile:
+        raise SocialError("gamecenter verification unavailable")
+
+    async def verify_steam(
+        self, app_id: int, publisher_key: str, token: str
+    ) -> SocialProfile:
+        raise SocialError("steam verification unavailable")
+
+    async def verify_apple(self, bundle_id: str, token: str) -> SocialProfile:
+        raise SocialError("apple verification unavailable")
+
+
+class StubSocialClient(SocialClient):
+    """Offline deterministic verifier for tests/dev: `register(provider,
+    token, profile)` then the matching verify_* accepts that token."""
+
+    def __init__(self):
+        self._known: dict[tuple[str, str], SocialProfile] = {}
+
+    def register(self, provider: str, token: str, profile: SocialProfile):
+        self._known[(provider, token)] = profile
+
+    def _lookup(self, provider: str, token: str) -> SocialProfile:
+        profile = self._known.get((provider, token))
+        if profile is None:
+            raise SocialError(f"invalid {provider} token")
+        return profile
+
+    async def verify_facebook(self, token: str) -> SocialProfile:
+        return self._lookup("facebook", token)
+
+    async def verify_google(self, token: str) -> SocialProfile:
+        return self._lookup("google", token)
+
+    async def verify_steam(
+        self, app_id: int, publisher_key: str, token: str
+    ) -> SocialProfile:
+        return self._lookup("steam", token)
+
+    async def verify_apple(self, bundle_id: str, token: str) -> SocialProfile:
+        return self._lookup("apple", token)
+
+    async def verify_gamecenter(
+        self,
+        player_id: str,
+        bundle_id: str,
+        timestamp: int,
+        salt: str,
+        signature: str,
+        public_key_url: str,
+    ) -> SocialProfile:
+        if not player_id or not bundle_id or not salt or not signature:
+            raise SocialError("incomplete gamecenter credentials")
+        return self._lookup("gamecenter", player_id)

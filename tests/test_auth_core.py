@@ -1,0 +1,232 @@
+"""Auth flows, account CRUD, link/unlink (mirrors the reference's
+core_authenticate/core_link behaviors, SURVEY.md §2.2)."""
+
+import pytest
+
+from nakama_tpu.core import authenticate as auth
+from nakama_tpu.core import account as acct
+from nakama_tpu.core import link as link_mod
+from nakama_tpu.core.authenticate import AuthError
+from nakama_tpu.social import SocialProfile, StubSocialClient
+from nakama_tpu.storage import Database
+
+
+async def make_db():
+    db = Database(":memory:")
+    await db.connect()
+    return db
+
+
+DEVICE = "device-id-0123456789"
+
+
+async def test_device_create_then_login():
+    db = await make_db()
+    uid, uname, created = await auth.authenticate_device(db, DEVICE, None, True)
+    assert created and uid and uname
+    uid2, uname2, created2 = await auth.authenticate_device(
+        db, DEVICE, None, True
+    )
+    assert (uid2, uname2, created2) == (uid, uname, False)
+    await db.close()
+
+
+async def test_device_no_create_not_found():
+    db = await make_db()
+    with pytest.raises(AuthError) as ei:
+        await auth.authenticate_device(db, DEVICE, None, False)
+    assert ei.value.code == "not_found"
+    await db.close()
+
+
+async def test_device_id_validation():
+    db = await make_db()
+    with pytest.raises(AuthError):
+        await auth.authenticate_device(db, "short", None, True)
+    await db.close()
+
+
+async def test_username_conflict():
+    db = await make_db()
+    await auth.authenticate_device(db, DEVICE, "taken", True)
+    with pytest.raises(AuthError) as ei:
+        await auth.authenticate_device(db, "other-device-123456", "taken", True)
+    assert ei.value.code == "already_exists"
+    await db.close()
+
+
+async def test_email_flow_and_wrong_password():
+    db = await make_db()
+    uid, _, created = await auth.authenticate_email(
+        db, "player@example.com", "hunter2secret", None, True
+    )
+    assert created
+    uid2, _, created2 = await auth.authenticate_email(
+        db, "Player@Example.com", "hunter2secret", None, True
+    )
+    assert uid2 == uid and not created2  # case-insensitive email
+    with pytest.raises(AuthError) as ei:
+        await auth.authenticate_email(
+            db, "player@example.com", "wrongpassword", None, True
+        )
+    assert ei.value.code == "unauthenticated"
+    await db.close()
+
+
+async def test_custom_flow():
+    db = await make_db()
+    uid, _, created = await auth.authenticate_custom(
+        db, "custom-abc-123", None, True
+    )
+    assert created
+    _, _, created2 = await auth.authenticate_custom(
+        db, "custom-abc-123", None, True
+    )
+    assert not created2
+    with pytest.raises(AuthError):
+        await auth.authenticate_custom(db, "tiny", None, True)
+    await db.close()
+
+
+async def test_social_flows_with_stub():
+    db = await make_db()
+    social = StubSocialClient()
+    social.register(
+        "facebook",
+        "fbtok",
+        SocialProfile(provider="facebook", id="fb-1", display_name="FB User"),
+    )
+    social.register("google", "gtok", SocialProfile(provider="google", id="g-1"))
+    social.register("steam", "stok", SocialProfile(provider="steam", id="s-1"))
+    social.register("apple", "atok", SocialProfile(provider="apple", id="a-1"))
+
+    uid, _, created = await auth.authenticate_facebook(
+        db, social, "fbtok", None, True
+    )
+    assert created
+    account = await acct.get_account(db, uid)
+    assert account["user"]["facebook_id"] == "fb-1"
+    assert account["user"]["display_name"] == "FB User"
+
+    with pytest.raises(AuthError):
+        await auth.authenticate_google(db, social, "badtok", None, True)
+    uid_g, _, _ = await auth.authenticate_google(db, social, "gtok", None, True)
+    uid_s, _, _ = await auth.authenticate_steam(
+        db, social, 480, "pubkey", "stok", None, True
+    )
+    uid_a, _, _ = await auth.authenticate_apple(
+        db, social, "com.example", "atok", None, True
+    )
+    assert len({uid, uid_g, uid_s, uid_a}) == 4
+    await db.close()
+
+
+async def test_facebook_instant_signed_payload():
+    import base64
+    import hashlib
+    import hmac
+    import json
+
+    db = await make_db()
+    social = StubSocialClient()
+    secret = "appsecret"
+    payload = base64.urlsafe_b64encode(
+        json.dumps({"player_id": "fbig-77"}).encode()
+    ).decode().rstrip("=")
+    sig = base64.urlsafe_b64encode(
+        hmac.new(secret.encode(), payload.encode(), hashlib.sha256).digest()
+    ).decode().rstrip("=")
+    uid, _, created = await auth.authenticate_facebook_instant(
+        db, social, secret, f"{sig}.{payload}", None, True
+    )
+    assert created
+    # Tampered payload rejected.
+    with pytest.raises(AuthError):
+        await auth.authenticate_facebook_instant(
+            db, social, secret, f"{sig}.{payload}x", None, True
+        )
+    await db.close()
+
+
+async def test_account_update_and_get_users():
+    db = await make_db()
+    uid, _, _ = await auth.authenticate_device(db, DEVICE, "alice", True)
+    await acct.update_account(
+        db, uid, display_name="Alice", metadata={"clan": "red"}
+    )
+    account = await acct.get_account(db, uid)
+    assert account["user"]["display_name"] == "Alice"
+    assert account["devices"] == [{"id": DEVICE}]
+    users = await acct.get_users(db, usernames=["alice"])
+    assert len(users) == 1 and users[0]["id"] == uid
+    # Dedup across ids + usernames.
+    users = await acct.get_users(db, user_ids=[uid], usernames=["alice"])
+    assert len(users) == 1
+    await db.close()
+
+
+async def test_delete_account_tombstone():
+    db = await make_db()
+    uid, _, _ = await auth.authenticate_device(db, DEVICE, None, True)
+    await acct.delete_account(db, uid, recorded=True)
+    with pytest.raises(AuthError):
+        await acct.get_account(db, uid)
+    row = await db.fetch_one(
+        "SELECT * FROM user_tombstone WHERE user_id = ?", (uid,)
+    )
+    assert row is not None
+    await db.close()
+
+
+async def test_link_unlink_matrix():
+    db = await make_db()
+    social = StubSocialClient()
+    social.register("google", "gtok", SocialProfile(provider="google", id="g-9"))
+    uid, _, _ = await auth.authenticate_device(db, DEVICE, None, True)
+
+    # Cannot unlink the only method.
+    with pytest.raises(AuthError) as ei:
+        await link_mod.unlink_device(db, uid, DEVICE)
+    assert ei.value.code == "failed_precondition"
+
+    await link_mod.link_email(db, uid, "a@b.co.uk", "password123")
+    await link_mod.link_custom(db, uid, "custom-xyz-1")
+    await link_mod.link_google(db, social, uid, "gtok")
+    account = await acct.get_account(db, uid)
+    assert account["email"] == "a@b.co.uk"
+    assert account["user"]["google_id"] == "g-9"
+
+    # Another user cannot claim the same google id.
+    uid2, _, _ = await auth.authenticate_device(
+        db, "second-device-9876543", None, True
+    )
+    with pytest.raises(AuthError) as ei:
+        await link_mod.link_google(db, social, uid2, "gtok")
+    assert ei.value.code == "already_exists"
+
+    # Now u1 has 4 methods; unlink down to one.
+    await link_mod.unlink_device(db, uid, DEVICE)
+    await link_mod.unlink_custom(db, uid)
+    await link_mod.unlink_google(db, uid)
+    with pytest.raises(AuthError):
+        await link_mod.unlink_email(db, uid)  # last method stays
+    # Email+password login still works.
+    uid3, _, created = await auth.authenticate_email(
+        db, "a@b.co.uk", "password123", None, False
+    )
+    assert uid3 == uid and not created
+    await db.close()
+
+
+async def test_disabled_account_rejected():
+    db = await make_db()
+    uid, _, _ = await auth.authenticate_device(db, DEVICE, None, True)
+    import time
+
+    await db.execute(
+        "UPDATE users SET disable_time = ? WHERE id = ?", (time.time(), uid)
+    )
+    with pytest.raises(AuthError) as ei:
+        await auth.authenticate_device(db, DEVICE, None, True)
+    assert ei.value.code == "permission_denied"
+    await db.close()
