@@ -76,20 +76,26 @@ async def _count_auth_methods(db: Database, user_id: str) -> int:
     return row["methods"] + (devices["n"] if devices else 0)
 
 
-async def _unlink_column(db: Database, user_id: str, column: str) -> None:
-    """Refuse to remove the last remaining auth method (reference
-    core_unlink.go guarded UPDATE)."""
-    if await _count_auth_methods(db, user_id) <= 1:
-        raise AuthError(
-            "cannot unlink last auth method", "failed_precondition"
+async def _unlink_column(
+    db: Database, user_id: str, column: str, also_null: tuple[str, ...] = ()
+) -> None:
+    """Refuse to remove the last remaining auth method. The count and the
+    UPDATE run in one transaction so two concurrent unlinks cannot both
+    observe 2 remaining methods (reference core_unlink.go:160-169 does this
+    with a single guarded conditional UPDATE)."""
+    extra = "".join(f", {c} = NULL" for c in also_null)
+    async with db.tx():
+        if await _count_auth_methods(db, user_id) <= 1:
+            raise AuthError(
+                "cannot unlink last auth method", "failed_precondition"
+            )
+        n = await db.execute(
+            f"UPDATE users SET {column} = NULL{extra}, update_time = ?"
+            f" WHERE id = ? AND {column} IS NOT NULL",
+            (time.time(), user_id),
         )
-    n = await db.execute(
-        f"UPDATE users SET {column} = NULL, update_time = ? WHERE id = ?"
-        f" AND {column} IS NOT NULL",
-        (time.time(), user_id),
-    )
-    if n == 0:
-        raise AuthError(f"{column} not linked", "not_found")
+        if n == 0:
+            raise AuthError(f"{column} not linked", "not_found")
 
 
 # ----------------------------------------------------------------- device
@@ -107,21 +113,35 @@ async def link_device(db: Database, user_id: str, device_id: str) -> None:
                 "device already linked to another account", "already_exists"
             )
         return
-    await db.execute(
-        "INSERT INTO user_device (id, user_id) VALUES (?, ?)",
-        (device_id, user_id),
-    )
+    try:
+        await db.execute(
+            "INSERT INTO user_device (id, user_id) VALUES (?, ?)",
+            (device_id, user_id),
+        )
+    except UniqueViolationError as e:
+        # Lost an insert race; relinking one's own device stays idempotent.
+        row = await db.fetch_one(
+            "SELECT user_id FROM user_device WHERE id = ?", (device_id,)
+        )
+        if row is not None and row["user_id"] == user_id:
+            return
+        raise AuthError(
+            "device already linked to another account", "already_exists"
+        ) from e
 
 
 async def unlink_device(db: Database, user_id: str, device_id: str) -> None:
-    if await _count_auth_methods(db, user_id) <= 1:
-        raise AuthError("cannot unlink last auth method", "failed_precondition")
-    n = await db.execute(
-        "DELETE FROM user_device WHERE id = ? AND user_id = ?",
-        (device_id, user_id),
-    )
-    if n == 0:
-        raise AuthError("device not linked", "not_found")
+    async with db.tx():
+        if await _count_auth_methods(db, user_id) <= 1:
+            raise AuthError(
+                "cannot unlink last auth method", "failed_precondition"
+            )
+        n = await db.execute(
+            "DELETE FROM user_device WHERE id = ? AND user_id = ?",
+            (device_id, user_id),
+        )
+        if n == 0:
+            raise AuthError("device not linked", "not_found")
 
 
 # ------------------------------------------------------------ email/custom
@@ -131,7 +151,10 @@ async def link_email(
     db: Database, user_id: str, email: str, password: str
 ) -> None:
     email = (email or "").lower()
-    if not _EMAIL_RE.match(email):
+    # Same rule as authenticate_email (reference core_link.go:174 /
+    # api_authenticate.go:292: 10-255 chars) so a linked email can always
+    # authenticate.
+    if not _EMAIL_RE.match(email) or not (10 <= len(email) <= 255):
         raise AuthError("invalid email address")
     if not password or len(password) < 8:
         raise AuthError("password must be at least 8 characters")
@@ -141,7 +164,9 @@ async def link_email(
 
 
 async def unlink_email(db: Database, user_id: str) -> None:
-    await _unlink_column(db, user_id, "email")
+    # Reference core_unlink.go:152 clears the password with the email so the
+    # stale hash cannot authenticate via username.
+    await _unlink_column(db, user_id, "email", also_null=("password",))
 
 
 async def link_custom(db: Database, user_id: str, custom_id: str) -> None:

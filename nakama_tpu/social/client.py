@@ -51,17 +51,26 @@ class SocialClient:
         HMAC-SHA256(app_secret, payload-part)."""
         try:
             sig_part, payload_part = signed_player_info.split(".", 1)
+            expected = base64.urlsafe_b64decode(
+                sig_part + "=" * (-len(sig_part) % 4)
+            )
         except ValueError as e:
             raise SocialError("malformed signed player info") from e
-        expected = base64.urlsafe_b64decode(sig_part + "=" * (-len(sig_part) % 4))
         actual = hmac.new(
             app_secret.encode(), payload_part.encode(), hashlib.sha256
         ).digest()
         if not hmac.compare_digest(expected, actual):
             raise SocialError("signed player info signature mismatch")
-        data = json.loads(
-            base64.urlsafe_b64decode(payload_part + "=" * (-len(payload_part) % 4))
-        )
+        try:
+            data = json.loads(
+                base64.urlsafe_b64decode(
+                    payload_part + "=" * (-len(payload_part) % 4)
+                )
+            )
+        except ValueError as e:
+            raise SocialError("malformed signed player info") from e
+        if not isinstance(data, dict):
+            raise SocialError("malformed signed player info")
         player_id = data.get("player_id", "")
         if not player_id:
             raise SocialError("missing player_id")

@@ -149,10 +149,29 @@ class Database:
     async def _with_conn(self, fn):
         if self._conn is None:
             raise DatabaseError("database not connected")
+        in_tx = asyncio.current_task() is self._tx_owner
+
+        def _call(conn: sqlite3.Connection):
+            try:
+                return fn(conn)
+            except sqlite3.Error:
+                # A failed auto-commit statement leaves the connection inside
+                # python-sqlite3's implicit transaction; roll it back so the
+                # next BEGIN IMMEDIATE doesn't see a nested transaction.
+                # Explicit tx() blocks roll back in Transaction.__aexit__.
+                if not in_tx and conn.in_transaction:
+                    conn.rollback()
+                raise
+
         try:
-            return await self._run(fn, self._conn)
+            return await self._run(_call, self._conn)
         except sqlite3.IntegrityError as e:
-            raise UniqueViolationError(str(e)) from e
+            # Only genuine uniqueness conflicts map to UniqueViolationError
+            # (reference server/db_error.go checks pg code 23505); FK /
+            # NOT NULL / CHECK violations are plain database errors.
+            if "UNIQUE constraint failed" in str(e):
+                raise UniqueViolationError(str(e)) from e
+            raise DatabaseError(str(e)) from e
         except sqlite3.Error as e:
             raise DatabaseError(str(e)) from e
 

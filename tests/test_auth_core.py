@@ -189,11 +189,11 @@ async def test_link_unlink_matrix():
         await link_mod.unlink_device(db, uid, DEVICE)
     assert ei.value.code == "failed_precondition"
 
-    await link_mod.link_email(db, uid, "a@b.co.uk", "password123")
+    await link_mod.link_email(db, uid, "alice@b.co.uk", "password123")
     await link_mod.link_custom(db, uid, "custom-xyz-1")
     await link_mod.link_google(db, social, uid, "gtok")
     account = await acct.get_account(db, uid)
-    assert account["email"] == "a@b.co.uk"
+    assert account["email"] == "alice@b.co.uk"
     assert account["user"]["google_id"] == "g-9"
 
     # Another user cannot claim the same google id.
@@ -212,7 +212,7 @@ async def test_link_unlink_matrix():
         await link_mod.unlink_email(db, uid)  # last method stays
     # Email+password login still works.
     uid3, _, created = await auth.authenticate_email(
-        db, "a@b.co.uk", "password123", None, False
+        db, "alice@b.co.uk", "password123", None, False
     )
     assert uid3 == uid and not created
     await db.close()
