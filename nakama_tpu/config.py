@@ -87,6 +87,16 @@ class MatchmakerConfig:
     string_fields: int = 16
     max_party_size: int = 8
     embedding_dims: int = 16  # learned skill-embedding width
+    # Pools whose scanned column extent reaches this switch from the exact
+    # blockwise top-K kernel to the two-stage MXU kernel (device2.py).
+    big_pool_threshold: int = 32_768
+    emb_score_scale: float = 256.0  # stage-1 embedding-score quantisation
+    # Pipelined intervals: process() collects the PREVIOUS interval's device
+    # results and dispatches the current one, hiding device+transfer latency
+    # entirely. Ticket properties are immutable so candidate eligibility
+    # cannot go stale; removed tickets are filtered at collection. Adds one
+    # interval of matching latency; off by default.
+    interval_pipelining: bool = False
 
 
 @dataclass

@@ -134,6 +134,18 @@ class CompiledQuery:
     has_must: bool
     has_should: bool
     never: bool  # contradictory query: matches nothing
+    # Exact (f64 bounds, 63-bit hashes) mirror used by the vectorized host
+    # validation of device-formed matches — immune to the f32 rounding and
+    # 31-bit hash collisions the device tensors accept.
+    n_lo64: np.ndarray | None = None  # f64 [Fn]
+    n_hi64: np.ndarray | None = None
+    n_flo64: np.ndarray | None = None
+    n_fhi64: np.ndarray | None = None
+    s_req64: np.ndarray | None = None  # i64 [Fs]
+    s_forb64: np.ndarray | None = None
+    sh_lo64: np.ndarray | None = None  # f64 [S]
+    sh_hi64: np.ndarray | None = None
+    sh_term64: np.ndarray | None = None  # i64 [S]
 
 
 class HostOnlyQuery(Exception):
@@ -177,6 +189,45 @@ def compile_features(
     return num, strs, overflow
 
 
+def exact_features(
+    ticket: MatchmakerTicket, registry: FieldRegistry
+) -> tuple[np.ndarray, np.ndarray]:
+    """f64/63-bit-hash mirror of compile_features for host validation:
+    (num64 f64 [Fn] with NaN = missing, str64 i64 [Fs] with 0 = unset)."""
+    num = np.full(registry.numeric_capacity, np.nan, dtype=np.float64)
+    strs = np.zeros(registry.string_capacity, dtype=np.int64)
+    num[registry.numeric["min_count"]] = ticket.min_count
+    num[registry.numeric["max_count"]] = ticket.max_count
+    num[registry.numeric["created_at"]] = ticket.created_at
+    if ticket.party_id:
+        strs[registry.string["party_id"]] = hash64(ticket.party_id)
+    strs[registry.string["ticket"]] = hash64(ticket.ticket)
+    for name, value in ticket.numeric_properties.items():
+        col = registry.numeric.get(f"properties.{name}")
+        if col is not None:
+            num[col] = float(value)
+    for name, value in ticket.string_properties.items():
+        col = registry.string.get(f"properties.{name}")
+        if col is not None:
+            strs[col] = hash64(value)
+    return num, strs
+
+
+def _range_bounds64(leaf) -> tuple[float, float]:
+    """Exact f64 bounds with open endpoints nudged one ulp, matching the
+    oracle evaluator's comparison semantics (query.py _leaf_match)."""
+    if isinstance(leaf, NumericEq):
+        # The oracle accepts |value - target| <= 1e-9 (query.py:283).
+        v = float(leaf.value)
+        return v - 1e-9, v + 1e-9
+    lo, hi = float(leaf.lo), float(leaf.hi)
+    if not leaf.incl_lo and np.isfinite(lo):
+        lo = np.nextafter(lo, np.inf)
+    if not leaf.incl_hi and np.isfinite(hi):
+        hi = np.nextafter(hi, -np.inf)
+    return lo, hi
+
+
 def _range_bounds(leaf) -> tuple[np.float32, np.float32]:
     if isinstance(leaf, NumericEq):
         v = np.float32(leaf.value)
@@ -214,6 +265,15 @@ def compile_query(
         has_must=False,
         has_should=False,
         never=False,
+        n_lo64=np.full(fn, -np.inf),
+        n_hi64=np.full(fn, np.inf),
+        n_flo64=np.full(fn, 1.0),
+        n_fhi64=np.full(fn, -1.0),
+        s_req64=np.zeros(fs, dtype=np.int64),
+        s_forb64=np.zeros(fs, dtype=np.int64),
+        sh_lo64=np.zeros(should_slots),
+        sh_hi64=np.zeros(should_slots),
+        sh_term64=np.zeros(should_slots, dtype=np.int64),
     )
 
     if isinstance(node, MatchAll):
@@ -236,6 +296,9 @@ def compile_query(
                 raise HostOnlyQuery(f"numeric field budget: {leaf.field_name}")
             lo, hi = _range_bounds(leaf)
             clamp_range(col, lo, hi)
+            lo64, hi64 = _range_bounds64(leaf)
+            c.n_lo64[col] = max(c.n_lo64[col], lo64)
+            c.n_hi64[col] = min(c.n_hi64[col], hi64)
             if c.n_lo[col] > c.n_hi[col]:
                 c.never = True
         elif isinstance(leaf, Term):
@@ -246,6 +309,7 @@ def compile_query(
             if c.s_req[col] not in (0, h):
                 c.never = True  # two different required values
             c.s_req[col] = h
+            c.s_req64[col] = hash64(leaf.value)
         elif isinstance(leaf, MatchAll):
             pass
         else:
@@ -261,6 +325,7 @@ def compile_query(
             lo, hi = _range_bounds(leaf)
             c.n_flo[col] = lo
             c.n_fhi[col] = hi
+            c.n_flo64[col], c.n_fhi64[col] = _range_bounds64(leaf)
         elif isinstance(leaf, Term):
             col = registry.string_col(leaf.field_name)
             if col is None:
@@ -269,6 +334,7 @@ def compile_query(
             if c.s_forb[col] not in (0, h):
                 raise HostOnlyQuery("two must-not terms on one field")
             c.s_forb[col] = h
+            c.s_forb64[col] = hash64(leaf.value)
         elif isinstance(leaf, MatchAll):
             c.never = True
         else:
@@ -289,6 +355,7 @@ def compile_query(
             c.sh_fld[slot] = col
             c.sh_lo[slot] = max(lo, -CLAMP)
             c.sh_hi[slot] = min(hi, CLAMP)
+            c.sh_lo64[slot], c.sh_hi64[slot] = _range_bounds64(leaf)
         elif isinstance(leaf, Term):
             col = registry.string_col(leaf.field_name)
             if col is None:
@@ -296,6 +363,7 @@ def compile_query(
             c.sh_op[slot] = SOP_STR_EQ
             c.sh_fld[slot] = col
             c.sh_term[slot] = hash_str(leaf.value)
+            c.sh_term64[slot] = hash64(leaf.value)
         else:
             raise HostOnlyQuery(f"should clause {type(leaf).__name__}")
     return c

@@ -85,11 +85,29 @@ def _scatter(pool: dict, idx: jnp.ndarray, rows: dict) -> dict:
 
 
 class PoolBuffer:
-    """Slot-allocated, device-resident ticket pool with queued updates."""
+    """Slot-allocated, device-resident ticket pool with queued updates.
 
-    def __init__(self, capacity: int, fn: int, fs: int, s: int, d: int = 16):
+    Updates flush eagerly in chunks as tickets stream in (`flush_chunk`),
+    so the H2D transfer rides the gaps between intervals instead of the
+    interval critical path; `flush()` at interval start only pushes the
+    partial tail. `on_flush(stacked_rows)` lets the backend observe value
+    distributions (bucket-grid maintenance for the MXU kernel) off the
+    critical path too."""
+
+    def __init__(
+        self,
+        capacity: int,
+        fn: int,
+        fs: int,
+        s: int,
+        d: int = 16,
+        flush_chunk: int = 8192,
+        on_flush=None,
+    ):
         self.capacity = capacity
         self.fn, self.fs, self.s, self.d = fn, fs, s, d
+        self.flush_chunk = flush_chunk
+        self.on_flush = on_flush
         host = pool_schema(capacity, fn, fs, s, d)
         self.device = jax.tree.map(jnp.asarray, host)
         self._empty_row = {
@@ -114,6 +132,8 @@ class PoolBuffer:
         self.high_water = max(self.high_water, slot + 1)
         self._pending_idx.append(slot)
         self._pending_rows.append(row)
+        if len(self._pending_idx) >= self.flush_chunk:
+            self.flush()
         return slot
 
     def remove(self, ticket_id: str):
@@ -152,6 +172,8 @@ class PoolBuffer:
         )
         self._pending_idx.clear()
         self._pending_rows.clear()
+        if self.on_flush is not None:
+            self.on_flush(stacked)
 
 
 def _accepts(qrow: dict, fcol: dict, with_should: bool):

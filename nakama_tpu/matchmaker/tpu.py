@@ -3,22 +3,30 @@
 Per interval (the reference's LocalMatchmaker.Process hot loop re-framed,
 SURVEY.md §2.5):
 
-1. flush queued ticket updates into the device pool buffer (one scatter),
-2. run the blockwise pairwise-eligibility + top-K kernel on device for every
-   active, compilable ticket at once,
-3. hand the candidate lists to the native C++ greedy assembler for the exact
+1. flush the tail of the queued ticket updates (bulk updates stream to the
+   device eagerly in chunks as tickets are added — the H2D transfer rides
+   the gap between intervals, not the interval),
+2. score actives against the pool on device:
+   - small pools: the exact blockwise top-K kernel (device.py),
+   - large pools (>= config.big_pool_threshold columns): the two-stage MXU
+     kernel (device2.py) — bucket-mask matmul prefilter + exact re-rank,
+3. while the candidate lists transfer back asynchronously, run the CPU
+   oracle for host-only actives (regex/wildcard queries, field overflow),
+4. hand the candidate lists to the native C++ greedy assembler for exact
    sequential combo formation,
-4. run the CPU oracle for the rare host-only actives (regex/wildcard queries
-   or field-budget overflow) over the leftover pool,
-5. when rev_precision is on, post-validate combo-internal mutual matches on
-   host with the real query ASTs (group sizes are small).
+5. validate every formed match on host against exact (f64 / 63-bit hash)
+   query mirrors — vectorized over all pairs at once — guarding the f32
+   rounding and 31-bit hash collisions the device tensors admit; fully
+   mutual validation when rev_precision is on.
 
-Host-side per-slot metadata (counts, intervals, session hashes) lives in
-persistent numpy arrays updated on add/remove, so an interval never loops
-over the whole pool in Python.
+Host-side per-slot metadata (counts, intervals, session hashes, exact query
+mirrors) lives in persistent numpy arrays updated on add/remove, so an
+interval never loops over the whole pool in Python.
 """
 
 from __future__ import annotations
+
+
 
 import numpy as np
 
@@ -29,11 +37,17 @@ from .. import native
 from .compile import (
     FULL_HI,
     FULL_LO,
+    SOP_ALL,
+    SOP_NUM_RANGE,
+    SOP_STR_EQ,
+    SOP_UNUSED,
+    CLAMP,
     CompiledQuery,
     FieldRegistry,
     HostOnlyQuery,
     compile_features,
     compile_query,
+    exact_features,
     hash64,
     hash_str,
 )
@@ -46,6 +60,7 @@ from .device import (
     pad_to,
     topk_candidates,
 )
+from .device2 import topk_candidates_big
 from .process import _mutual, process_default
 from .types import MatchmakerEntry, MatchmakerTicket
 
@@ -60,6 +75,8 @@ class TpuBackend:
         metrics: Metrics | None = None,
         row_block: int = 256,
         col_block: int = 2048,
+        big_row_block: int = 1024,
+        big_col_block: int = 1024,
     ):
         self.config = config
         self.logger = logger.with_fields(subsystem="matchmaker.tpu")
@@ -71,12 +88,28 @@ class TpuBackend:
         self.k = config.candidates_per_ticket
         self.row_block = row_block
         self.col_block = min(col_block, cap)
-        if cap % self.col_block:
-            raise ValueError("pool_capacity must be a multiple of col_block")
+        self.big_row_block = big_row_block
+        self.big_col_block = min(big_col_block, cap)
+        if cap % self.col_block or cap % self.big_col_block:
+            raise ValueError("pool_capacity must be a multiple of col blocks")
+        from .device2 import MAX_COLS
+
+        if cap > MAX_COLS and config.big_pool_threshold <= cap:
+            raise ValueError(
+                f"pool_capacity {cap} exceeds the big-kernel column limit "
+                f"{MAX_COLS}; shard the pool or raise big_pool_threshold "
+                f"above the capacity to stay on the exact kernel"
+            )
 
         self.d = config.embedding_dims
         self.registry = FieldRegistry(self.fn, self.fs)
-        self.pool = PoolBuffer(cap, self.fn, self.fs, self.s, self.d)
+        self.pool = PoolBuffer(
+            cap, self.fn, self.fs, self.s, self.d,
+            on_flush=self._observe_chunk,
+        )
+        import jax
+
+        self._interpret = jax.devices()[0].platform != "tpu"
 
         # Host-side per-slot metadata for the native assembler.
         sps = config.max_party_size
@@ -90,15 +123,54 @@ class TpuBackend:
             "session_hashes": np.zeros((cap, sps), dtype=np.uint64),
             "session_counts": np.zeros(cap, dtype=np.int32),
         }
+        # Exact query/value mirrors for vectorized match validation.
+        s = self.s
+        self.exact = {
+            "v_num": np.full((cap, self.fn), np.nan),
+            "v_str": np.zeros((cap, self.fs), dtype=np.int64),
+            "q_lo": np.full((cap, self.fn), -np.inf),
+            "q_hi": np.full((cap, self.fn), np.inf),
+            "q_flo": np.ones((cap, self.fn)),
+            "q_fhi": np.full((cap, self.fn), -1.0),
+            "q_req": np.zeros((cap, self.fs), dtype=np.int64),
+            "q_forb": np.zeros((cap, self.fs), dtype=np.int64),
+            "q_sh_op": np.zeros((cap, s), dtype=np.int32),
+            "q_sh_fld": np.zeros((cap, s), dtype=np.int32),
+            "q_sh_lo": np.zeros((cap, s)),
+            "q_sh_hi": np.zeros((cap, s)),
+            "q_sh_term": np.zeros((cap, s), dtype=np.int64),
+            "q_has_must": np.zeros(cap, dtype=bool),
+            "q_has_should": np.zeros(cap, dtype=bool),
+            "q_exact_ok": np.zeros(cap, dtype=bool),
+        }
         self.ticket_at: list[MatchmakerTicket | None] = [None] * cap
+        self._slot_live = np.zeros(cap, dtype=bool)
         self.host_only: set[str] = set()
         self._should_tickets: set[str] = set()
         self._embedding_tickets: set[str] = set()
         # Monotone lower bound on live created_seq: keeps the kernel's
         # wait-time tie-break penalty small on long-lived servers.
         self._created_base = 0
+        # Pipelined-interval state: the previous interval's in-flight device
+        # result, collected at the next process() call.
+        self._pipeline_prev: tuple | None = None
+        # Observed numeric value range per field (bucket grid for the MXU
+        # kernel); stale-wide ranges only cost precision, never correctness.
+        self._grid_lo = np.full(self.fn, np.inf)
+        self._grid_hi = np.full(self.fn, -np.inf)
 
     # -------------------------------------------------- pool notifications
+
+    def _observe_chunk(self, stacked: dict[str, np.ndarray]):
+        valid = (stacked["flags"] & FLAG_VALID) != 0
+        num = stacked["num"][valid]
+        if not len(num):
+            return
+        real = num < CLAMP  # excludes the MISSING sentinel
+        masked_lo = np.where(real, num, np.inf).min(axis=0)
+        masked_hi = np.where(real, num, -np.inf).max(axis=0)
+        np.minimum(self._grid_lo, masked_lo, out=self._grid_lo)
+        np.maximum(self._grid_hi, masked_hi, out=self._grid_hi)
 
     def on_add(self, ticket: MatchmakerTicket, pool_id: int = 0):
         # Validate and compile everything BEFORE mutating any backend state,
@@ -189,10 +261,37 @@ class TpuBackend:
             m["session_hashes"][slot, i] = hash64(sid)
         self.ticket_at[slot] = ticket
 
+        self._slot_live[slot] = True
+        ex = self.exact
+        num64, str64 = exact_features(ticket, self.registry)
+        ex["v_num"][slot] = num64
+        ex["v_str"][slot] = str64
+        if cq is not None:
+            # Pure query bounds only: count-range compatibility is a
+            # candidate-search filter (one-directional) plus the assembler's
+            # formed-size crosscheck, NOT part of mutual query acceptance.
+            ex["q_lo"][slot] = cq.n_lo64
+            ex["q_hi"][slot] = cq.n_hi64
+            ex["q_flo"][slot] = cq.n_flo64
+            ex["q_fhi"][slot] = cq.n_fhi64
+            ex["q_req"][slot] = cq.s_req64
+            ex["q_forb"][slot] = cq.s_forb64
+            ex["q_sh_op"][slot] = cq.sh_op
+            ex["q_sh_fld"][slot] = cq.sh_fld
+            ex["q_sh_lo"][slot] = cq.sh_lo64
+            ex["q_sh_hi"][slot] = cq.sh_hi64
+            ex["q_sh_term"][slot] = cq.sh_term64
+            ex["q_has_must"][slot] = cq.has_must
+            ex["q_has_should"][slot] = cq.has_should
+            ex["q_exact_ok"][slot] = True
+        else:
+            ex["q_exact_ok"][slot] = False
+
     def on_remove(self, ticket_id: str):
         slot = self.pool.slot_of.get(ticket_id)
         if slot is not None:
             self.ticket_at[slot] = None
+            self._slot_live[slot] = False
             self.meta["session_counts"][slot] = 0
         self.pool.remove(ticket_id)
         self.host_only.discard(ticket_id)
@@ -222,6 +321,8 @@ class TpuBackend:
 
         matched: list[list[MatchmakerEntry]] = []
         selected: set[str] = set()
+        work = None
+        pipelined = self.config.interval_pipelining
 
         if device_actives:
             slots = np.asarray(
@@ -238,46 +339,37 @@ class TpuBackend:
                 ],
                 dtype=np.uint8,
             )
-
             self.pool.flush()
-            # Pad counts to power-of-two buckets: one compiled program per
-            # bucket, not per interval.
-            n_blocks = -(-len(slots) // self.row_block)
-            a_pad = self.row_block * (1 << (n_blocks - 1).bit_length())
-            col_blocks = -(-self.pool.high_water // self.col_block)
-            n_cols = min(
-                self.col_block * (1 << max(0, col_blocks - 1).bit_length()),
-                self.pool.capacity,
-            )
-            scores, cand = topk_candidates(
-                self.pool.device,
-                pad_to(slots, a_pad, -1),
-                k=min(self.k, n_cols),
-                br=self.row_block,
-                bc=self.col_block,
-                rev=rev_precision,
-                n_cols=n_cols,
-                with_should=bool(self._should_tickets),
-                with_embedding=bool(self._embedding_tickets),
-                created_base=np.int32(self._created_base),
-            )
-            cand_np = np.asarray(cand)[: len(slots)]
-            scores_np = np.asarray(scores)[: len(slots)]
-            # Exact re-sort of each candidate list by (-score, created):
-            # the kernel's wait-time epsilon only biased the top-K cutoff.
-            created_of = self.meta["created"][np.maximum(cand_np, 0)]
-            created_of = np.where(
-                cand_np < 0, np.iinfo(np.int64).max, created_of
-            )
-            by_created = np.argsort(created_of, axis=1, kind="stable")
-            s2 = np.take_along_axis(scores_np, by_created, axis=1)
-            by_score = np.argsort(-s2, axis=1, kind="stable")
-            order = np.take_along_axis(by_created, by_score, axis=1)
-            cand_np = np.ascontiguousarray(
-                np.take_along_axis(cand_np, order, axis=1)
-            )
+            pending = self._dispatch(slots, rev_precision)
+            work = (pending, slots, last_interval, len(device_actives))
+            if pipelined:
+                # Collect LAST interval's in-flight result instead; the one
+                # just dispatched computes + transfers while the server does
+                # everything else (ticket properties are immutable, so its
+                # candidates cannot go stale — only dead slots, masked at
+                # collection).
+                work, self._pipeline_prev = self._pipeline_prev, work
+        elif pipelined and self._pipeline_prev is not None:
+            work, self._pipeline_prev = self._pipeline_prev, None
 
-            slot_matches = native.assemble(
+        if host_actives:
+            # Runs while the device computes and the candidate lists stream
+            # back.
+            host_matched, _ = process_default(
+                host_actives,
+                pool,
+                max_intervals=max_intervals,
+                rev_precision=rev_precision,
+                bump_intervals=False,
+                preselected=selected,
+            )
+            for entry_set in host_matched:
+                matched.append(entry_set)
+                selected.update(e.ticket for e in entry_set)
+
+        if pending is not None:
+            cand_np = self._collect(pending, len(device_actives))
+            n_matches, offsets, flat = native.assemble_arrays(
                 slots,
                 last_interval,
                 cand_np,
@@ -290,20 +382,17 @@ class TpuBackend:
                 session_hashes=self.meta["session_hashes"],
                 session_counts=self.meta["session_counts"],
             )
-
-            for match_slots in slot_matches:
-                tickets = [self.ticket_at[s] for s in match_slots]
-                if any(t is None for t in tickets):
+            ok = self._validate_bulk(
+                n_matches, offsets, flat, rev_precision
+            )
+            for i in range(n_matches):
+                if not ok[i]:
                     continue
-                # Host-side validation with the real query ASTs guards
-                # against 31-bit hash collisions and f32 bound rounding on
-                # device: one-sided (the searcher accepts every member,
-                # the oracle's non-rev guarantee) or fully mutual under
-                # rev_precision.
-                if rev_precision:
-                    if not self._mutual_group(tickets):
-                        continue
-                elif not self._searcher_accepts(tickets):
+                match_slots = flat[offsets[i] : offsets[i + 1]]
+                tickets = [self.ticket_at[s] for s in match_slots]
+                if any(
+                    t is None or t.ticket in selected for t in tickets
+                ):
                     continue
                 entries: list[MatchmakerEntry] = []
                 for t in tickets:
@@ -311,34 +400,198 @@ class TpuBackend:
                 matched.append(entries)
                 selected.update(t.ticket for t in tickets)
 
-        if host_actives:
-            host_matched, _ = process_default(
-                host_actives,
-                pool,
-                max_intervals=max_intervals,
-                rev_precision=rev_precision,
-                bump_intervals=False,
-                preselected=selected,
-            )
-            matched.extend(host_matched)
-
         return matched, expired
 
-    def _searcher_accepts(self, tickets: list[MatchmakerTicket]) -> bool:
-        """The active (searching) ticket is last; its query must accept every
-        other member's document."""
-        from .query import matches
+    # ------------------------------------------------------------- dispatch
 
-        active = tickets[-1]
-        return all(
-            matches(active.parsed_query, t.document()) for t in tickets[:-1]
+    def _dispatch(self, slots: np.ndarray, rev: bool):
+        """Launch the device top-K for the given active slots; returns an
+        opaque pending handle whose transfer is already in flight."""
+        hw = self.pool.high_water
+        with_should = bool(self._should_tickets)
+        with_embedding = bool(self._embedding_tickets)
+        big = hw >= self.config.big_pool_threshold
+
+        if big:
+            bm, bn = self.big_row_block, self.big_col_block
+
+            def bucket(blocks: int) -> int:
+                # pow2 up to 16 blocks, then multiples of 16: bounded
+                # compile-shape count with <= 1.15x padding waste at scale.
+                if blocks <= 16:
+                    return 1 << max(0, blocks - 1).bit_length()
+                return -(-blocks // 16) * 16
+
+            n_cols = min(self.pool.capacity, bucket(-(-hw // bn)) * bn)
+            a_pad = bucket(-(-len(slots) // bm)) * bm
+
+            width = self._grid_hi - self._grid_lo
+            ok = np.isfinite(width) & (width >= 0)
+            grid_lo = np.where(ok, self._grid_lo, 0.0).astype(np.float32)
+            grid_inv = (
+                1.0 / np.maximum(np.where(ok, width, 1.0), 1e-30)
+            ).astype(np.float32)
+            cand_dev = topk_candidates_big(
+                self.pool.device,
+                pad_to(slots, a_pad, -1),
+                grid_lo,
+                grid_inv,
+                fn=self.fn,
+                fs=self.fs,
+                n_cols=n_cols,
+                k=self.k,
+                rev=rev,
+                with_should=with_should,
+                with_embedding=with_embedding,
+                bm=bm,
+                bn=bn,
+                interpret=self._interpret,
+                emb_scale=self.config.emb_score_scale,
+            )
+            try:
+                cand_dev.copy_to_host_async()
+            except Exception:
+                pass
+            return ("big", cand_dev)
+
+        # Small-pool exact path (unchanged round-1 kernel).
+        n_blocks = -(-len(slots) // self.row_block)
+        a_pad = self.row_block * (1 << max(0, n_blocks - 1).bit_length())
+        col_blocks = -(-hw // self.col_block)
+        n_cols = min(
+            self.col_block * (1 << max(0, col_blocks - 1).bit_length()),
+            self.pool.capacity,
         )
+        scores, cand = topk_candidates(
+            self.pool.device,
+            pad_to(slots, a_pad, -1),
+            k=min(self.k, n_cols),
+            br=self.row_block,
+            bc=self.col_block,
+            rev=rev,
+            n_cols=n_cols,
+            with_should=with_should,
+            with_embedding=with_embedding,
+            created_base=np.int32(self._created_base),
+        )
+        return ("small", scores, cand)
 
-    def _mutual_group(self, tickets: list[MatchmakerTicket]) -> bool:
-        """Combo-internal mutual validation with real query ASTs (the device
-        kernel only guarantees mutuality against the active ticket)."""
-        for i in range(len(tickets)):
-            for j in range(len(tickets)):
-                if i != j and not _mutual(tickets[i], tickets[j]):
-                    return False
-        return True
+    def _collect(self, pending, n_rows: int) -> np.ndarray:
+        """Materialize the pending device result into created/score-ordered
+        candidate slot lists [n_rows, k]."""
+        if pending[0] == "big":
+            # Already exactly ordered by (-score, created) on device.
+            return np.ascontiguousarray(np.asarray(pending[1])[:n_rows])
+
+        _, scores, cand = pending
+        cand_np = np.asarray(cand)[:n_rows]
+        scores_np = np.asarray(scores)[:n_rows]
+        # Exact re-sort of each candidate list by (-score, created):
+        # the kernel's wait-time epsilon only biased the top-K cutoff.
+        created_of = self.meta["created"][np.maximum(cand_np, 0)]
+        created_of = np.where(cand_np < 0, np.iinfo(np.int64).max, created_of)
+        by_created = np.argsort(created_of, axis=1, kind="stable")
+        s2 = np.take_along_axis(scores_np, by_created, axis=1)
+        by_score = np.argsort(-s2, axis=1, kind="stable")
+        order = np.take_along_axis(by_created, by_score, axis=1)
+        return np.ascontiguousarray(np.take_along_axis(cand_np, order, axis=1))
+
+    # ----------------------------------------------------------- validation
+
+    def _pair_accepts64(
+        self, q_slots: np.ndarray, v_slots: np.ndarray
+    ) -> np.ndarray:
+        """Exact vectorized `query(q) accepts values(v)` per pair."""
+        ex = self.exact
+        lo = ex["q_lo"][q_slots]
+        hi = ex["q_hi"][q_slots]
+        v = ex["v_num"][v_slots]
+        unconstrained = np.isneginf(lo) & np.isposinf(hi)
+        ok = np.all(((v >= lo) & (v <= hi)) | unconstrained, axis=1)
+        in_forb = (v >= ex["q_flo"][q_slots]) & (v <= ex["q_fhi"][q_slots])
+        ok &= ~np.any(in_forb, axis=1)
+        sv = ex["v_str"][v_slots]
+        req = ex["q_req"][q_slots]
+        forb = ex["q_forb"][q_slots]
+        ok &= np.all(
+            ((req == 0) | (sv == req)) & ((forb == 0) | (sv != forb)), axis=1
+        )
+        gate = (~ex["q_has_must"][q_slots]) & ex["q_has_should"][q_slots]
+        if gate.any():
+            qs = q_slots[gate]
+            vs = v_slots[gate]
+            op = ex["q_sh_op"][qs]
+            fld = ex["q_sh_fld"][qs]
+            rows = np.arange(len(qs))[:, None]
+            nv = ex["v_num"][vs][rows, fld]
+            s2 = ex["v_str"][vs][rows, fld]
+            term = ex["q_sh_term"][qs]
+            sat = np.where(
+                op == SOP_NUM_RANGE,
+                (nv >= ex["q_sh_lo"][qs]) & (nv <= ex["q_sh_hi"][qs]),
+                np.where(
+                    op == SOP_STR_EQ,
+                    (s2 == term) & (term != 0),
+                    op == SOP_ALL,
+                ),
+            )
+            ok[gate] &= np.any(sat & (op != SOP_UNUSED), axis=1)
+        return ok
+
+    def _validate_bulk(
+        self,
+        n_matches: int,
+        offsets: np.ndarray,
+        flat: np.ndarray,
+        rev: bool,
+    ) -> np.ndarray:
+        """Validity of each assembled match: the searcher (last slot) must
+        accept every member — every ordered pair must be mutual under
+        rev_precision (reference validateMatch, server/matchmaker.go:
+        1042-1068). Vectorized over all pairs of all matches."""
+        if n_matches == 0:
+            return np.zeros(0, dtype=bool)
+        flat = flat[: offsets[n_matches]]
+        sizes = offsets[1 : n_matches + 1] - offsets[:n_matches]
+        mid = np.repeat(np.arange(n_matches), sizes)
+        searcher_pos = offsets[1 : n_matches + 1] - 1
+        is_searcher = np.zeros(len(flat), dtype=bool)
+        is_searcher[searcher_pos] = True
+        ok = np.ones(n_matches, dtype=bool)
+
+        if not rev:
+            q = flat[searcher_pos][mid[~is_searcher]]
+            v = flat[~is_searcher]
+            pair_ok = self._pair_accepts64(q, v)
+            np.logical_and.at(ok, mid[~is_searcher], pair_ok)
+            return ok
+
+        # Mutual: all ordered pairs. Matches containing host-only members
+        # (no exact query mirror) fall back to the AST evaluator.
+        exact_ok = self.exact["q_exact_ok"][flat]
+        fallback = np.zeros(n_matches, dtype=bool)
+        np.logical_or.at(fallback, mid, ~exact_ok)
+        ms = int(sizes.max())
+        padded = np.full((n_matches, ms), -1, dtype=flat.dtype)
+        padded[mid, np.concatenate([np.arange(s) for s in sizes])] = flat
+        qi = np.repeat(padded[:, :, None], ms, axis=2)
+        vj = np.repeat(padded[:, None, :], ms, axis=1)
+        valid_pair = (qi >= 0) & (vj >= 0) & (qi != vj)
+        fb_rows = fallback[:, None, None] | ~valid_pair
+        pair_ok = np.ones((n_matches, ms, ms), dtype=bool)
+        sel = ~fb_rows
+        if sel.any():
+            pair_ok[sel] = self._pair_accepts64(qi[sel], vj[sel])
+        ok = pair_ok.all(axis=(1, 2))
+        for i in np.nonzero(fallback)[0]:
+            tickets = [
+                self.ticket_at[s]
+                for s in flat[offsets[i] : offsets[i + 1]]
+            ]
+            ok[i] = all(t is not None for t in tickets) and all(
+                _mutual(a, b)
+                for a in tickets
+                for b in tickets
+                if a is not b
+            )
+        return ok

@@ -63,6 +63,20 @@ def _ptr(arr: np.ndarray, dtype) -> ctypes.c_void_p:
 
 
 def assemble(
+    active_slots: np.ndarray,
+    last_interval: np.ndarray,
+    cand: np.ndarray,
+    **kw,
+) -> list[list[int]]:
+    """Run the native greedy assembler; returns matches as slot lists, the
+    active ticket's slot last in each."""
+    n, offsets, slots = assemble_arrays(active_slots, last_interval, cand, **kw)
+    return [
+        slots[offsets[i] : offsets[i + 1]].tolist() for i in range(n)
+    ]
+
+
+def assemble_arrays(
     active_slots: np.ndarray,  # i32 [A]
     last_interval: np.ndarray,  # u8 [A]
     cand: np.ndarray,  # i32 [A, K]
@@ -75,13 +89,14 @@ def assemble(
     created: np.ndarray,  # i64 [slots]
     session_hashes: np.ndarray,  # u64 [slots, stride]
     session_counts: np.ndarray,  # i32 [slots]
-) -> list[list[int]]:
-    """Run the native greedy assembler; returns matches as slot lists, the
-    active ticket's slot last in each."""
+) -> tuple[int, np.ndarray, np.ndarray]:
+    """Like `assemble` but returns (n_matches, offsets i32 [n+1], flat slot
+    array) without materializing Python lists — the bulk-validation path
+    consumes the arrays directly."""
     lib = load()
     a = len(active_slots)
     if a == 0:
-        return []
+        return 0, np.zeros(1, dtype=np.int32), np.zeros(0, dtype=np.int32)
     k = cand.shape[1] if cand.ndim == 2 else 0
     n_slots = len(min_count)
     stride = session_hashes.shape[1]
@@ -113,7 +128,4 @@ def assemble(
     )
     if n < 0:
         raise RuntimeError("assembler output buffer overflow")
-    return [
-        out_slots[out_offsets[i] : out_offsets[i + 1]].tolist()
-        for i in range(n)
-    ]
+    return n, out_offsets, out_slots

@@ -1,0 +1,109 @@
+"""One-off stage breakdown of a matchmaker interval on the real chip.
+
+Not part of the test suite — a profiling harness for the perf work
+(VERDICT round 1 weak #2/#8). Writes a jax.profiler trace when
+PROFILE_TRACE=1.
+"""
+
+import os
+import time
+
+import numpy as np
+
+POOL = int(os.environ.get("BENCH_POOL", 100_000))
+
+from bench import build_ticket, fill  # noqa: E402
+from nakama_tpu.config import MatchmakerConfig  # noqa: E402
+from nakama_tpu.logger import test_logger  # noqa: E402
+from nakama_tpu.matchmaker import LocalMatchmaker  # noqa: E402
+from nakama_tpu.matchmaker.tpu import TpuBackend  # noqa: E402
+from nakama_tpu.matchmaker import device as dev  # noqa: E402
+from nakama_tpu import native  # noqa: E402
+
+
+def main():
+    import jax
+
+    rng = np.random.default_rng(42)
+    cap = 1 << (POOL + POOL // 2 - 1).bit_length()
+    cfg = MatchmakerConfig(
+        pool_capacity=cap,
+        candidates_per_ticket=32,
+        numeric_fields=8,
+        string_fields=8,
+        max_constraints=8,
+        max_intervals=2,
+    )
+    backend = TpuBackend(cfg, test_logger(), row_block=256, col_block=2048)
+    mm = LocalMatchmaker(test_logger(), cfg, backend=backend)
+
+    t0 = time.perf_counter()
+    fill(mm, rng, POOL, "w")
+    print(f"fill {POOL}: {time.perf_counter()-t0:.2f}s")
+
+    # Monkeypatch-instrument the backend stages.
+    times = {}
+
+    orig_topk = dev.topk_candidates
+    orig_assemble = native.assemble
+
+    def timed_topk(*a, **kw):
+        t = time.perf_counter()
+        out = orig_topk(*a, **kw)
+        jax.block_until_ready(out)
+        times["kernel"] = times.get("kernel", 0) + time.perf_counter() - t
+        return out
+
+    def timed_assemble(*a, **kw):
+        t = time.perf_counter()
+        out = orig_assemble(*a, **kw)
+        times["assemble"] = times.get("assemble", 0) + time.perf_counter() - t
+        return out
+
+    import nakama_tpu.matchmaker.tpu as tpu_mod
+
+    tpu_mod.topk_candidates = timed_topk
+    tpu_mod.native.assemble = timed_assemble
+
+    orig_flush = backend.pool.flush
+
+    def timed_flush():
+        t = time.perf_counter()
+        orig_flush()
+        jax.block_until_ready(backend.pool.device)
+        times["flush"] = times.get("flush", 0) + time.perf_counter() - t
+
+    backend.pool.flush = timed_flush
+
+    for interval in range(5):
+        deficit = POOL - len(mm)
+        if deficit:
+            t = time.perf_counter()
+            fill(mm, rng, deficit, f"i{interval}-")
+            refill_s = time.perf_counter() - t
+        else:
+            refill_s = 0.0
+        times.clear()
+        trace = os.environ.get("PROFILE_TRACE") and interval == 3
+        if trace:
+            jax.profiler.start_trace("/tmp/mm_trace")
+        t = time.perf_counter()
+        confirmed = mm.process()
+        total = time.perf_counter() - t
+        if trace:
+            jax.profiler.stop_trace()
+            print("trace written to /tmp/mm_trace")
+        other = total - sum(times.values())
+        print(
+            f"interval {interval}: total={total*1000:.1f}ms "
+            f"kernel={times.get('kernel',0)*1000:.1f} "
+            f"flush={times.get('flush',0)*1000:.1f} "
+            f"assemble={times.get('assemble',0)*1000:.1f} "
+            f"other-host={other*1000:.1f} "
+            f"(refill {refill_s:.2f}s, matched {sum(len(s) for s in confirmed)} entries, "
+            f"hw {backend.pool.high_water}, active {len([1 for _ in confirmed])})"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Two-stage MXU kernel path (device2.py): validity + quality parity vs the
+CPU oracle, running the Pallas stage-1 in interpreter mode on the virtual
+CPU device. Mirrors the small-kernel parity tier (test_matchmaker_tpu.py)
+at a pool size that exercises the bucket-mask prefilter + exact re-rank."""
+
+import numpy as np
+import pytest
+
+from nakama_tpu.config import MatchmakerConfig
+from nakama_tpu.logger import test_logger as quiet_logger
+from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+from nakama_tpu.matchmaker.tpu import TpuBackend
+
+from test_matchmaker_tpu import (  # reuse fixtures/validators
+    _random_pool,
+    _run,
+    _validate_matches,
+)
+
+
+def make_big_mm(**kw):
+    cfg = MatchmakerConfig(
+        pool_capacity=2048,
+        candidates_per_ticket=32,
+        numeric_fields=8,
+        string_fields=8,
+        max_constraints=8,
+        big_pool_threshold=64,  # force the two-stage path
+        **kw,
+    )
+    collected = []
+    backend = TpuBackend(
+        cfg,
+        quiet_logger(),
+        row_block=8,
+        col_block=64,
+        big_row_block=64,
+        big_col_block=64,
+    )
+    mm = LocalMatchmaker(
+        quiet_logger(), cfg, backend=backend, on_matched=collected.append
+    )
+    return mm, collected
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("rev", [False, True])
+def test_big_path_parity_random_pools(seed, rev):
+    rng = np.random.default_rng(seed)
+    specs = _random_pool(rng, 64, party_frac=0.25, multiple=True)
+
+    cfg = MatchmakerConfig(max_intervals=2, rev_precision=rev)
+    cpu_mm = LocalMatchmaker(quiet_logger(), cfg)
+    cpu_matches = _run(cpu_mm, specs)
+
+    mm, _ = make_big_mm(max_intervals=2, rev_precision=rev)
+    assert mm.backend.config.big_pool_threshold == 64
+    tpu_matches = _run(mm, specs)
+
+    cpu_count = _validate_matches(cpu_matches, specs, mutual=rev)
+    tpu_count = _validate_matches(tpu_matches, specs, mutual=rev)
+    # Every big-path match must be valid (checked above). Quality: at this
+    # deliberately tiny pool (64 tickets forced through the big path) the
+    # jittered selection can land a greedy outcome a few entries either
+    # side of the oracle's; allow that variance here — the at-scale quality
+    # bar (where the big path exists) is test_big_path_1v1_diversity, and
+    # the oracle-exact small path covers exact parity.
+    assert tpu_count >= cpu_count - 6
+
+
+def test_big_path_1v1_diversity():
+    """The jittered per-block winners must avoid the candidate-concentration
+    starvation: nearly the whole pool pairs up in one interval."""
+    mm, got = make_big_mm(max_intervals=2)
+    n = 512
+    rng = np.random.default_rng(3)
+    for i in range(n):
+        rank = float(rng.integers(0, 100))
+        p = MatchmakerPresence(user_id=f"u{i}", session_id=f"s{i}")
+        mm.add(
+            [p],
+            p.session_id,
+            "",
+            f"+properties.rank:>={max(0.0, rank - 30)}"
+            f" +properties.rank:<={rank + 30}",
+            2,
+            2,
+            1,
+            {},
+            {"rank": rank},
+        )
+    mm.process()
+    matched_entries = sum(len(s) for batch in got for s in batch)
+    assert matched_entries >= int(0.8 * n), matched_entries
+    # Formed pairs must truly satisfy both rank windows one-directionally
+    # (searcher side) — validated inside the backend; spot-check sizes.
+    for batch in got:
+        for entry_set in batch:
+            assert len(entry_set) == 2
+
+
+def test_big_path_embedding_scoring():
+    """Embedding similarity steers candidate choice on the big path."""
+    mm, got = make_big_mm(max_intervals=1)
+    e = np.zeros(16, np.float32)
+    e[0] = 1.0
+    f = np.zeros(16, np.float32)
+    f[0] = -1.0
+    for i, emb in enumerate([e, e, f]):
+        p = MatchmakerPresence(user_id=f"eu{i}", session_id=f"es{i}")
+        mm.add([p], p.session_id, "", "*", 2, 2, 1, {}, {}, embedding=emb)
+    mm.process()
+    assert got
+    # The two aligned embeddings must pair; the anti-aligned one stays.
+    for batch in got:
+        for entry_set in batch:
+            users = sorted(x.presence.user_id for x in entry_set)
+            assert users == ["eu0", "eu1"]
