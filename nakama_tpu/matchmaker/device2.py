@@ -42,8 +42,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .compile import CLAMP, MISSING
-from .device import FLAG_HAS_MUST, FLAG_HAS_SHOULD, FLAG_NEVER, FLAG_VALID
+from .device import FLAG_NEVER, FLAG_VALID
 from .device import _accepts  # exact per-field predicate (block form)
 
 NUM_BUCKETS = 16  # per numeric field
@@ -63,16 +62,28 @@ def encoding_dims(fn: int, fs: int) -> int:
 # --------------------------------------------------------------- stage 1
 
 
+def _bucket_of(x, grid_lo, grid_inv):
+    """Bucket index of `x` per numeric field → i32, clipped to [0, NB-1].
+
+    The ONE bucketing expression used for both value encoding and query
+    mask bounds: it is monotone non-decreasing in x (f32 sub/mul by a
+    positive constant and trunc are all monotone), so computing the query's
+    allowed range as [bucket_of(lo), bucket_of(hi)] is guaranteed to cover
+    the bucket of every value in [lo, hi] — the stage-1 superset property
+    holds bit-for-bit, with no separately-rounded edge reconstruction."""
+    t = (x - grid_lo[None]) * grid_inv[None] * NUM_BUCKETS
+    # f32->i32 conversion of out-of-range values (±FULL bounds can overflow
+    # to inf after the multiply) is implementation-defined in XLA; clamp in
+    # float first. Applied identically on both sides, so monotone
+    # consistency is preserved.
+    t = jnp.clip(t, -2.0**30, 2.0**30)
+    return jnp.clip(t.astype(jnp.int32), 0, NUM_BUCKETS - 1)
+
+
 def _value_vectors(pool, n, fn, fs, grid_lo, grid_inv):
     """Bucket one-hot encodings of candidate values → [n, D] bf16."""
     num = pool["num"][:n]  # [n, fn]
-    b = jnp.clip(
-        ((num - grid_lo[None]) * grid_inv[None] * NUM_BUCKETS).astype(
-            jnp.int32
-        ),
-        0,
-        NUM_BUCKETS - 1,
-    )
+    b = _bucket_of(num, grid_lo, grid_inv)
     oh_num = (
         b[:, :, None] == jnp.arange(NUM_BUCKETS, dtype=jnp.int32)[None, None]
     )
@@ -94,35 +105,36 @@ def _value_vectors(pool, n, fn, fs, grid_lo, grid_inv):
     return (v & valid).astype(jnp.bfloat16)
 
 
-def _query_vectors(q, fn, fs, grid_lo, grid_inv):
+def _query_vectors(q, fn, fs, grid_lo, grid_inv, with_counts=True):
     """Allowed-bucket masks of queries → [rows, D] bf16. `q` carries n_lo,
     n_hi, n_flo, n_fhi, s_req, min_count, max_count, pool_id, flags; any
-    bucket that *could* contain an accepted value is set (conservative)."""
+    bucket that *could* contain an accepted value is set (conservative).
+
+    `with_counts=False` for the reverse (mutual) direction: count-range
+    compatibility is a forward candidate-search filter only, NOT part of
+    mutual query acceptance (oracle _mutual checks queries alone)."""
     rows = q["n_lo"].shape[0]
-    bucket_w = 1.0 / (jnp.maximum(grid_inv, 1e-38) * NUM_BUCKETS)
-    edges = grid_lo[:, None] + bucket_w[:, None] * jnp.arange(
-        NUM_BUCKETS + 1, dtype=jnp.float32
-    )
-    edge_lo = edges[:, :-1].at[:, 0].set(-jnp.inf)  # [fn, NB]
-    edge_hi = edges[:, 1:].at[:, -1].set(jnp.inf)
+    n_lo, n_hi = q["n_lo"], q["n_hi"]
+    if with_counts:
+        # Count-range compatibility as builtin-column bounds (reference
+        # appends min_count/max_count clauses to every search,
+        # server/matchmaker_process.go:65-85): candidate.min_count >= mine
+        # and candidate.max_count <= mine. Builtin columns 0 and 1
+        # (compile.py BUILTIN_NUMERIC order).
+        n_lo = n_lo.at[:, 0].max(q["min_count"].astype(jnp.float32))
+        n_hi = n_hi.at[:, 1].min(q["max_count"].astype(jnp.float32))
 
-    # Count-range compatibility as builtin-column bounds (reference appends
-    # min_count/max_count clauses to every search,
-    # server/matchmaker_process.go:65-85): candidate.min_count >= mine and
-    # candidate.max_count <= mine. Builtin columns 0 and 1 (compile.py
-    # BUILTIN_NUMERIC order).
-    n_lo = q["n_lo"].at[:, 0].max(q["min_count"].astype(jnp.float32))
-    n_hi = q["n_hi"].at[:, 1].min(q["max_count"].astype(jnp.float32))
-
-    allowed = (n_lo[:, :, None] <= edge_hi[None]) & (
-        n_hi[:, :, None] >= edge_lo[None]
-    )  # [rows, fn, NB]
-    # Buckets entirely inside a forbidden interval can never hold an
-    # accepted value.
-    cut = (q["n_flo"][:, :, None] <= edge_lo[None]) & (
-        q["n_fhi"][:, :, None] >= edge_hi[None]
-    )
-    allowed = allowed & ~cut
+    bt = jnp.arange(NUM_BUCKETS, dtype=jnp.int32)[None, None]
+    b_lo = _bucket_of(n_lo, grid_lo, grid_inv)[:, :, None]
+    b_hi = _bucket_of(n_hi, grid_lo, grid_inv)[:, :, None]
+    allowed = (bt >= b_lo) & (bt <= b_hi)  # [rows, fn, NB]
+    # Buckets strictly between the forbidden bounds' buckets hold only
+    # forbidden values (monotonicity of _bucket_of); the boundary buckets
+    # themselves may straddle, so they stay allowed (conservative). Empty
+    # intervals (flo > fhi) cut nothing since b(flo) >= b(fhi).
+    bf_lo = _bucket_of(q["n_flo"], grid_lo, grid_inv)[:, :, None]
+    bf_hi = _bucket_of(q["n_fhi"], grid_lo, grid_inv)[:, :, None]
+    allowed = allowed & ~((bt > bf_lo) & (bt < bf_hi))
 
     req = q["s_req"]  # [rows, fs]; 0 = unconstrained
     oh_req = (req & (STR_BUCKETS - 1))[:, :, None] == jnp.arange(
@@ -292,7 +304,9 @@ def topk_candidates_big(
         ve = jnp.zeros((n, 8), jnp.bfloat16)
     if rev:
         uv = vv[safe]
-        vq = _query_vectors(pool_n, fn, fs, grid_lo, grid_inv)
+        vq = _query_vectors(
+            pool_n, fn, fs, grid_lo, grid_inv, with_counts=False
+        )
     else:
         uv = jnp.zeros((a_pad, 8), jnp.bfloat16)
         vq = jnp.zeros((n, 8), jnp.bfloat16)

@@ -74,7 +74,13 @@ class ProcessBackend(Protocol):
         *,
         max_intervals: int,
         rev_precision: bool,
-    ) -> tuple[list[list[MatchmakerEntry]], list[str]]: ...
+    ) -> tuple[list[list[MatchmakerEntry]], list[str], set[str]]:
+        """Returns (matched entry sets, expired ticket ids, reactivate ids).
+
+        `reactivate` covers tickets whose pipelined match was invalidated
+        after they already went inactive — they get another active interval
+        so churn can't strand them passively matchable forever."""
+        ...
 
 
 class CpuBackend:
@@ -87,12 +93,13 @@ class CpuBackend:
         pass
 
     def process(self, actives, pool, *, max_intervals, rev_precision):
-        return process_default(
+        matched, expired = process_default(
             actives,
             pool,
             max_intervals=max_intervals,
             rev_precision=rev_precision,
         )
+        return matched, expired, set()
 
 
 class LocalMatchmaker:
@@ -262,8 +269,9 @@ class LocalMatchmaker:
                 rev_precision=self.config.rev_precision,
                 override_fn=self.override_fn,
             )
+            reactivate: set[str] = set()
         else:
-            matched, expired = self.backend.process(
+            matched, expired, reactivate = self.backend.process(
                 actives,
                 self.tickets,
                 max_intervals=self.config.max_intervals,
@@ -272,6 +280,10 @@ class LocalMatchmaker:
 
         for ticket_id in expired:
             self.active.pop(ticket_id, None)
+        for ticket_id in reactivate:
+            ticket = self.tickets.get(ticket_id)
+            if ticket is not None and ticket_id not in self.active:
+                self.active[ticket_id] = ticket
 
         # Remove matched tickets from the pool. A set may have been raced out
         # by an explicit removal between snapshot and now (possible only for

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 
 
+import jax
 import numpy as np
 
 from ..config import MatchmakerConfig
@@ -60,7 +61,7 @@ from .device import (
     pad_to,
     topk_candidates,
 )
-from .device2 import topk_candidates_big
+from .device2 import MAX_COLS, topk_candidates_big
 from .process import _mutual, process_default
 from .types import MatchmakerEntry, MatchmakerTicket
 
@@ -92,8 +93,6 @@ class TpuBackend:
         self.big_col_block = min(big_col_block, cap)
         if cap % self.col_block or cap % self.big_col_block:
             raise ValueError("pool_capacity must be a multiple of col blocks")
-        from .device2 import MAX_COLS
-
         if cap > MAX_COLS and config.big_pool_threshold <= cap:
             raise ValueError(
                 f"pool_capacity {cap} exceeds the big-kernel column limit "
@@ -107,8 +106,6 @@ class TpuBackend:
             cap, self.fn, self.fs, self.s, self.d,
             on_flush=self._observe_chunk,
         )
-        import jax
-
         self._interpret = jax.devices()[0].platform != "tpu"
 
         # Host-side per-slot metadata for the native assembler.
@@ -144,7 +141,9 @@ class TpuBackend:
             "q_exact_ok": np.zeros(cap, dtype=bool),
         }
         self.ticket_at: list[MatchmakerTicket | None] = [None] * cap
-        self._slot_live = np.zeros(cap, dtype=bool)
+        # Bumped on every slot (re)assignment; a pipelined interval snapshots
+        # it at dispatch so collection can drop matches touching reused slots.
+        self._slot_gen = np.zeros(cap, dtype=np.int64)
         self.host_only: set[str] = set()
         self._should_tickets: set[str] = set()
         self._embedding_tickets: set[str] = set()
@@ -261,7 +260,7 @@ class TpuBackend:
             m["session_hashes"][slot, i] = hash64(sid)
         self.ticket_at[slot] = ticket
 
-        self._slot_live[slot] = True
+        self._slot_gen[slot] += 1
         ex = self.exact
         num64, str64 = exact_features(ticket, self.registry)
         ex["v_num"][slot] = num64
@@ -291,7 +290,6 @@ class TpuBackend:
         slot = self.pool.slot_of.get(ticket_id)
         if slot is not None:
             self.ticket_at[slot] = None
-            self._slot_live[slot] = False
             self.meta["session_counts"][slot] = 0
         self.pool.remove(ticket_id)
         self.host_only.discard(ticket_id)
@@ -307,7 +305,7 @@ class TpuBackend:
         *,
         max_intervals: int,
         rev_precision: bool,
-    ) -> tuple[list[list[MatchmakerEntry]], list[str]]:
+    ) -> tuple[list[list[MatchmakerEntry]], list[str], set[str]]:
         # Interval bookkeeping, vectorized (reference bumps per-active in the
         # loop; equivalent because matched actives leave the pool anyway).
         expired: list[str] = []
@@ -341,7 +339,8 @@ class TpuBackend:
             )
             self.pool.flush()
             pending = self._dispatch(slots, rev_precision)
-            work = (pending, slots, last_interval, len(device_actives))
+            gen_snap = self._slot_gen.copy() if pipelined else self._slot_gen
+            work = (pending, slots, last_interval, len(device_actives), gen_snap)
             if pipelined:
                 # Collect LAST interval's in-flight result instead; the one
                 # just dispatched computes + transfers while the server does
@@ -351,6 +350,11 @@ class TpuBackend:
                 work, self._pipeline_prev = self._pipeline_prev, work
         elif pipelined and self._pipeline_prev is not None:
             work, self._pipeline_prev = self._pipeline_prev, None
+
+        # Tickets whose assembled match was dropped after they may already
+        # have gone inactive (pipelined collection lags dispatch by one
+        # interval): give them another active interval.
+        reactivate: set[str] = set()
 
         if host_actives:
             # Runs while the device computes and the candidate lists stream
@@ -367,11 +371,12 @@ class TpuBackend:
                 matched.append(entry_set)
                 selected.update(e.ticket for e in entry_set)
 
-        if pending is not None:
-            cand_np = self._collect(pending, len(device_actives))
+        if work is not None:
+            w_pending, w_slots, w_last_interval, w_n, w_gen = work
+            cand_np = self._collect(w_pending, w_n)
             n_matches, offsets, flat = native.assemble_arrays(
-                slots,
-                last_interval,
+                w_slots,
+                w_last_interval,
                 cand_np,
                 min_count=self.meta["min_count"],
                 max_count=self.meta["max_count"],
@@ -386,13 +391,28 @@ class TpuBackend:
                 n_matches, offsets, flat, rev_precision
             )
             for i in range(n_matches):
-                if not ok[i]:
-                    continue
                 match_slots = flat[offsets[i] : offsets[i + 1]]
                 tickets = [self.ticket_at[s] for s in match_slots]
-                if any(
-                    t is None or t.ticket in selected for t in tickets
+                stale = not np.array_equal(
+                    w_gen[match_slots], self._slot_gen[match_slots]
+                )
+                # stale: a slot was reused between dispatch and collection
+                # (pipelined interval) — its properties/query no longer match
+                # what the kernel scored, so the match must be dropped.
+                if (
+                    not ok[i]
+                    or stale
+                    or any(
+                        t is None or t.ticket in selected for t in tickets
+                    )
                 ):
+                    if pipelined:
+                        # Only the pipeline lag can strand an inactive
+                        # ticket; non-pipelined drops keep reference
+                        # single-shot semantics.
+                        for t in tickets:
+                            if t is not None:
+                                reactivate.add(t.ticket)
                     continue
                 entries: list[MatchmakerEntry] = []
                 for t in tickets:
@@ -400,7 +420,8 @@ class TpuBackend:
                 matched.append(entries)
                 selected.update(t.ticket for t in tickets)
 
-        return matched, expired
+        reactivate -= selected
+        return matched, expired, reactivate
 
     # ------------------------------------------------------------- dispatch
 
@@ -523,8 +544,12 @@ class TpuBackend:
             op = ex["q_sh_op"][qs]
             fld = ex["q_sh_fld"][qs]
             rows = np.arange(len(qs))[:, None]
-            nv = ex["v_num"][vs][rows, fld]
-            s2 = ex["v_str"][vs][rows, fld]
+            # fld indexes numeric fields for SOP_NUM_RANGE and string fields
+            # for SOP_STR_EQ; the widths differ, so clamp each lookup to its
+            # own array (the op select below discards the clamped garbage) —
+            # mirrors jnp.take's clamping in the device kernel.
+            nv = ex["v_num"][vs][rows, np.minimum(fld, self.fn - 1)]
+            s2 = ex["v_str"][vs][rows, np.minimum(fld, self.fs - 1)]
             term = ex["q_sh_term"][qs]
             sat = np.where(
                 op == SOP_NUM_RANGE,

@@ -44,12 +44,22 @@ def main():
     # Monkeypatch-instrument the backend stages.
     times = {}
 
-    orig_topk = dev.topk_candidates
-    orig_assemble = native.assemble
+    import nakama_tpu.matchmaker.tpu as tpu_mod
+
+    orig_topk = tpu_mod.topk_candidates
+    orig_topk_big = tpu_mod.topk_candidates_big
+    orig_assemble = native.assemble_arrays
 
     def timed_topk(*a, **kw):
         t = time.perf_counter()
         out = orig_topk(*a, **kw)
+        jax.block_until_ready(out)
+        times["kernel"] = times.get("kernel", 0) + time.perf_counter() - t
+        return out
+
+    def timed_topk_big(*a, **kw):
+        t = time.perf_counter()
+        out = orig_topk_big(*a, **kw)
         jax.block_until_ready(out)
         times["kernel"] = times.get("kernel", 0) + time.perf_counter() - t
         return out
@@ -60,10 +70,9 @@ def main():
         times["assemble"] = times.get("assemble", 0) + time.perf_counter() - t
         return out
 
-    import nakama_tpu.matchmaker.tpu as tpu_mod
-
     tpu_mod.topk_candidates = timed_topk
-    tpu_mod.native.assemble = timed_assemble
+    tpu_mod.topk_candidates_big = timed_topk_big
+    tpu_mod.native.assemble_arrays = timed_assemble
 
     orig_flush = backend.pool.flush
 

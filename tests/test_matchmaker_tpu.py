@@ -291,3 +291,65 @@ def test_parity_identical_on_1v1():
         return out
 
     assert pairs(cpu_matches) == pairs(tpu_matches)
+
+
+def test_should_clause_on_high_index_numeric_field():
+    # Regression: _pair_accepts64 indexed both v_num (numeric_fields wide)
+    # and v_str (string_fields wide) with the shared sh_fld matrix; a
+    # should-gated numeric range on a numeric field with index >=
+    # string_fields raised IndexError and killed the whole interval.
+    mm, got = make_tpu_mm(string_fields=4)
+    # numeric cols: 3 builtins + f0..f4 fill all 8; f4 lands at col 7,
+    # which is >= string_fields=4 — and the registry does NOT overflow, so
+    # the tickets stay on the device path where _pair_accepts64 runs.
+    nums = {f"f{i}": float(i) for i in range(5)}
+    add(mm, "properties.f4:>=1", nums=nums)
+    add(mm, "properties.f4:>=1", nums=nums)
+    assert not mm.backend.host_only
+    mm.process()
+    assert len(got) == 1 and len(got[0][0]) == 2
+
+
+def test_pipelined_slot_reuse_is_dropped():
+    # Regression: under interval_pipelining, a slot freed and reused between
+    # dispatch and collection was validated against the NEW occupant's exact
+    # mirrors while the kernel scored the OLD occupant — the new ticket could
+    # be delivered into a match the old one earned.
+    mm, got = make_tpu_mm(interval_pipelining=True, max_intervals=10)
+    t1, p1 = add(mm, "properties.mode:a", strs={"mode": "a"})
+    t2, p2 = add(mm, "properties.mode:a", strs={"mode": "a"})
+    mm.process()  # dispatch only: first pipelined interval collects nothing
+    assert not got
+    slot2 = mm.backend.pool.slot_of[t2]
+    mm.remove([t2])
+    # Wildcard query + mode=b values: validation against t3's own mirror
+    # passes, but pairing it into t1's match violates t1's query.
+    t3, p3 = add(mm, "*", strs={"mode": "b"})
+    assert mm.backend.pool.slot_of[t3] == slot2  # LIFO free list reuses slot
+    mm.process()  # collects interval-1 work referencing the reused slot
+    matched_users = {
+        e.presence.user_id for batch in got for match in batch for e in match
+    }
+    assert p3.user_id not in matched_users
+
+
+def test_pipelined_dropped_match_reactivates_members():
+    # Regression: a min==max ticket goes inactive after its single active
+    # interval; under pipelining its work is collected one interval later,
+    # and if that match is invalidated by churn the ticket was stranded
+    # passively forever. Backends now reactivate members of dropped matches.
+    mm, got = make_tpu_mm(interval_pipelining=True, max_intervals=10)
+    t1, _ = add(mm, "properties.mode:a", strs={"mode": "a"})
+    t2, _ = add(mm, "properties.mode:a", strs={"mode": "a"})
+    mm.process()  # dispatch W1 (u1,u2)
+    mm.remove([t2])
+    add(mm, "*", strs={"mode": "b"})  # reuses t2's slot
+    mm.process()  # W1's (t1,t2) match dropped via gen check; t1 reactivated
+    # fresh compatible pair; with t1 reactivated everyone can still pair up
+    p4 = add(mm, "properties.mode:a", strs={"mode": "a"})[1]
+    p5 = add(mm, "properties.mode:a", strs={"mode": "a"})[1]
+    for _ in range(6):
+        mm.process()
+    # every mode:a ticket must eventually match (t1 with the wildcard or a
+    # fresh one; the fresh pair with each other) — nothing stranded
+    assert len(mm) <= 1, (len(mm), [t.query for t in mm.tickets.values()])
