@@ -63,7 +63,9 @@ class SocketConfig:
 
 @dataclass
 class DatabaseConfig:
-    address: list[str] = field(default_factory=lambda: ["nakama.db"])
+    # ":memory:" = embedded non-durable default; point at a file path for
+    # durability (reference default is a live Postgres DSN, config.go).
+    address: list[str] = field(default_factory=lambda: [":memory:"])
     driver: str = "sqlite"  # sqlite today; asyncpg seam for postgres
     conn_max_lifetime_ms: int = 3_600_000
     max_open_conns: int = 100

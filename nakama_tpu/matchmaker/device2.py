@@ -178,6 +178,7 @@ def _stage1_kernel(
     f_tot: float,
     bn: int,
     m: int,
+    out_w: int,
     with_embedding: bool,
     rev: bool,
     emb_scale: float,
@@ -228,13 +229,24 @@ def _stage1_kernel(
     # big pools where the block count itself provides candidate width, and
     # grows for low-block-count pools). Packed words are unique per column,
     # so equality removes exactly the previous winner.
-    bests = []
+    #
+    # The output block is one full-width [bm, out_w] row stripe revisited
+    # across all column blocks (index map ignores j) — Mosaic requires the
+    # lane dim of a block to be 128-divisible or array-width, so a narrow
+    # per-block (bm, m) output is not lowerable. Each j deposits its m
+    # winners into lanes [j*m, (j+1)*m) with a masked lane-select.
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.full_like(out_ref[:], PACKED_NONE)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (win.shape[0], out_w), 1)
+    acc = out_ref[:]
     for t in range(m):
         cur = jnp.max(win, axis=1, keepdims=True)  # [bm, 1]
-        bests.append(cur)
         if t + 1 < m:
             win = jnp.where(win == cur, jnp.int32(PACKED_NONE), win)
-    out_ref[:] = jnp.concatenate(bests, axis=1)
+        acc = jnp.where(lane == j * m + t, cur, acc)
+    out_ref[:] = acc
 
 
 @functools.partial(
@@ -313,11 +325,13 @@ def topk_candidates_big(
 
     de = ue.shape[1]
     dq = uv.shape[1]
+    out_w = -(-(n_blocks * m) // 128) * 128  # lane-dim must be 128-aligned
     kernel = functools.partial(
         _stage1_kernel,
         f_tot=float(fn + fs + 1),
         bn=bn,
         m=m,
+        out_w=out_w,
         with_embedding=with_embedding,
         rev=rev,
         emb_scale=emb_scale,
@@ -337,9 +351,9 @@ def topk_candidates_big(
             pl.BlockSpec((bn, dq), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (bm, m), lambda i, j: (i, j), memory_space=pltpu.VMEM
+            (bm, out_w), lambda i, j: (i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((a_pad, n_blocks * m), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((a_pad, out_w), jnp.int32),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=2 * a_pad * n * (d + (de if with_embedding else 0)),

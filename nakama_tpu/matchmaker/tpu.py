@@ -390,30 +390,41 @@ class TpuBackend:
             ok = self._validate_bulk(
                 n_matches, offsets, flat, rev_precision
             )
-            for i in range(n_matches):
-                match_slots = flat[offsets[i] : offsets[i + 1]]
-                tickets = [self.ticket_at[s] for s in match_slots]
-                stale = not np.array_equal(
-                    w_gen[match_slots], self._slot_gen[match_slots]
+            # Per-match accept/drop, vectorized: a Python loop over ~50k
+            # matches with per-match numpy ops measured ~3s/interval on the
+            # 100k bench — the aggregations below are O(total entries) numpy
+            # plus one slot->ticket sweep.
+            total = int(offsets[n_matches])
+            flat_t = flat[:total]
+            sizes = offsets[1 : n_matches + 1] - offsets[:n_matches]
+            mid = np.repeat(np.arange(n_matches), sizes)
+            # stale: a slot was reused between dispatch and collection
+            # (pipelined interval) — its properties/query no longer match
+            # what the kernel scored, so the match must be dropped.
+            stale_e = w_gen[flat_t] != self._slot_gen[flat_t]
+            ticket_at = self.ticket_at
+            tickets_flat = [ticket_at[s] for s in flat_t]
+            dead_e = np.fromiter(
+                (t is None for t in tickets_flat), bool, total
+            )
+            if selected:
+                sel_e = np.fromiter(
+                    (t is not None and t.ticket in selected
+                     for t in tickets_flat),
+                    bool,
+                    total,
                 )
-                # stale: a slot was reused between dispatch and collection
-                # (pipelined interval) — its properties/query no longer match
-                # what the kernel scored, so the match must be dropped.
-                if (
-                    not ok[i]
-                    or stale
-                    or any(
-                        t is None or t.ticket in selected for t in tickets
-                    )
-                ):
-                    if pipelined:
-                        # Only the pipeline lag can strand an inactive
-                        # ticket; non-pipelined drops keep reference
-                        # single-shot semantics.
-                        for t in tickets:
-                            if t is not None:
-                                reactivate.add(t.ticket)
-                    continue
+                dead_e |= sel_e
+            bad = ~ok
+            np.logical_or.at(bad, mid, stale_e | dead_e)
+            for i in np.nonzero(bad)[0] if pipelined else ():
+                # Only the pipeline lag can strand an inactive ticket;
+                # non-pipelined drops keep reference single-shot semantics.
+                for t in tickets_flat[offsets[i] : offsets[i + 1]]:
+                    if t is not None:
+                        reactivate.add(t.ticket)
+            for i in np.nonzero(~bad)[0]:
+                tickets = tickets_flat[offsets[i] : offsets[i + 1]]
                 entries: list[MatchmakerEntry] = []
                 for t in tickets:
                     entries.extend(t.entries)

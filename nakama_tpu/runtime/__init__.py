@@ -1,0 +1,16 @@
+"""Extensibility runtime (L3): hook registry, Python module provider, and
+the `nk` server-function module (reference server/runtime.go:493,
+runtime_go.go InitModule contract, runtime_go_nakama.go module API)."""
+
+from .loader import ModuleLoadError, load_runtime
+from .nk import NakamaModule
+from .registry import Initializer, Runtime, RuntimeContext
+
+__all__ = [
+    "Initializer",
+    "ModuleLoadError",
+    "NakamaModule",
+    "Runtime",
+    "RuntimeContext",
+    "load_runtime",
+]

@@ -39,11 +39,26 @@ class NakamaServer:
         config: Config,
         logger: Logger | None = None,
         matchmaker_backend=None,
+        database=None,
+        runtime_modules: list | None = None,
     ):
         self.config = config
         self.logger = logger or setup_logging(config.logger)
         log = self.logger
         node = config.name
+
+        # Persistence (reference DbConnect, main.go:129-133): constructed
+        # here, connected in start(). `database=None` builds the embedded
+        # engine from config.
+        from .storage import Database
+
+        self.db = database
+        self._owns_db = database is None
+        if self.db is None:
+            addr = (config.database.address or [":memory:"])[0]
+            self.db = Database(addr)
+        self._db_connected = False
+        self._runtime_modules = runtime_modules or []
 
         self.metrics = Metrics(config.metrics.namespace)
         self.session_registry = LocalSessionRegistry(log, self.metrics)
@@ -123,8 +138,9 @@ class NakamaServer:
 
     def attach_runtime(self, runtime):
         """Wire the extensibility runtime into the pipeline, the matchmaker
-        matched hook, and the match registry (reference NewRuntime wiring,
-        main.go:155-160)."""
+        matched hook, the match registry (named match factories), and the
+        session start/end events (reference NewRuntime wiring,
+        main.go:155-160; session_ws.go Close path)."""
         self.runtime = runtime
         self.pipeline.c.runtime = runtime
         self.matchmaker.on_matched = make_matched_handler(
@@ -137,10 +153,47 @@ class NakamaServer:
         override = getattr(runtime, "matchmaker_override", None)
         if override is not None and override() is not None:
             self.matchmaker.override_fn = override()
+        match_names = getattr(runtime, "match_names", None)
+        if match_names is not None:
+            for name in match_names():
+                self.match_registry.register(
+                    name, runtime.match_factory(name)
+                )
+        fire_start = getattr(runtime, "fire_session_start", None)
+        if fire_start is not None:
+            self.acceptor.on_session_start = fire_start
+            self.acceptor.on_session_end = runtime.fire_session_end
 
     # ------------------------------------------------------------ lifecycle
 
     async def start(self, port: int | None = None):
+        if not self._db_connected:
+            await self.db.connect()
+            self._db_connected = True
+        if self.runtime is None and (
+            self._runtime_modules or self.config.runtime.path
+        ):
+            from .runtime import load_runtime
+
+            runtime = load_runtime(
+                self.logger,
+                self.config,
+                modules=self._runtime_modules,
+                db=self.db,
+                session_cache=self.session_cache,
+                session_registry=self.session_registry,
+                tracker=self.tracker,
+                router=self.router,
+                stream_manager=self.stream_manager,
+                status_registry=self.status_registry,
+                matchmaker=self.matchmaker,
+                match_registry=self.match_registry,
+                party_registry=self.party_registry,
+                metrics=self.metrics,
+            )
+            self.attach_runtime(runtime)
+        if self.runtime is not None:
+            self.runtime.start_events()
         self.tracker.start()
         self.matchmaker.start()
         self._ws_server = await websockets.serve(
@@ -167,6 +220,13 @@ class NakamaServer:
         for session in self.session_registry.all():
             await session.close("server shutting down")
         self.tracker.stop()
+        if self.runtime is not None:
+            await self.runtime.shutdown()
+        # Close only a database we constructed; an injected one belongs to
+        # the caller (it may be shared or inspected after stop).
+        if self._db_connected and self._owns_db:
+            await self.db.close()
+            self._db_connected = False
         self.logger.info("server stopped")
 
     def issue_session(self, user_id: str, username: str) -> str:

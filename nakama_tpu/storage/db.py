@@ -47,6 +47,10 @@ class Database:
             conn.execute("PRAGMA synchronous=NORMAL")
             return conn
 
+        if self._executor._shutdown:  # re-connect after close()
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="nakama-db"
+            )
         self._conn = await self._run(_open)
         await self.migrate()
 

@@ -1,0 +1,380 @@
+"""Extensibility runtime (L3) tests — the VERDICT round-1 done-criteria:
+a loaded module registers an RPC callable over the socket, a before-hook
+mutates/rejects a matchmaker_add, a matchmaker override picks matches, a
+registered match handler runs authoritatively, and session start/end
+events fire. Mirrors the reference's runtime_test.go approach (modules
+loaded from temp dirs, hooks exercised through the full stack)."""
+
+import asyncio
+import json
+import time
+
+import pytest
+import websockets
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.runtime import (
+    Initializer,
+    ModuleLoadError,
+    Runtime,
+    load_runtime,
+)
+from nakama_tpu.server import NakamaServer
+
+
+class Client:
+    def __init__(self, ws):
+        self.ws = ws
+        self.inbox: list[dict] = []
+
+    @classmethod
+    async def connect(cls, server, user_id, username):
+        token = server.issue_session(user_id, username)
+        ws = await websockets.connect(
+            f"ws://127.0.0.1:{server.port}/ws?token={token}"
+        )
+        return cls(ws)
+
+    async def send(self, envelope):
+        await self.ws.send(json.dumps(envelope))
+
+    async def recv(self, key, timeout=5.0):
+        for i, e in enumerate(self.inbox):
+            if key in e:
+                return self.inbox.pop(i)
+        deadline = time.monotonic() + timeout
+        while True:
+            raw = await asyncio.wait_for(
+                self.ws.recv(), timeout=max(0.01, deadline - time.monotonic())
+            )
+            e = json.loads(raw)
+            if key in e:
+                return e
+            self.inbox.append(e)
+
+    async def close(self):
+        await self.ws.close()
+
+
+async def make_server(modules):
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(
+        config, quiet_logger(), runtime_modules=modules
+    )
+    await server.start()
+    return server
+
+
+# ------------------------------------------------------------------ loader
+
+
+def test_load_runtime_from_directory(tmp_path):
+    (tmp_path / "mod_a.py").write_text(
+        "def init_module(ctx, logger, nk, initializer):\n"
+        "    initializer.register_rpc('echo', lambda c, p: p)\n"
+    )
+    (tmp_path / "mod_b.py").write_text(
+        "def init_module(ctx, logger, nk, initializer):\n"
+        "    initializer.register_rpc('twice', lambda c, p: p + p)\n"
+    )
+    config = Config()
+    config.runtime.path = str(tmp_path)
+    runtime = load_runtime(quiet_logger(), config)
+    assert runtime.rpc_ids() == ["echo", "twice"]
+    assert len(runtime.modules) == 2
+    assert runtime.rpc("twice")(None, "ab") == "abab"
+
+
+def test_load_runtime_rejects_invalid_module(tmp_path):
+    (tmp_path / "bad.py").write_text("x = 1\n")  # no init_module
+    config = Config()
+    config.runtime.path = str(tmp_path)
+    with pytest.raises(ModuleLoadError):
+        load_runtime(quiet_logger(), config)
+
+
+def test_register_validation():
+    runtime = Runtime(quiet_logger(), Config())
+    init = Initializer(runtime)
+    with pytest.raises(Exception):
+        init.register_rpc("", lambda c, p: p)
+    init.register_before_rt("MatchmakerAdd", lambda c, k, b: b)
+    assert runtime.before_rt("matchmaker_add") is not None
+
+
+# ----------------------------------------------------------- rpc over ws
+
+
+async def test_rpc_over_socket():
+    def init_module(ctx, logger, nk, initializer):
+        def shout(ctx, payload):
+            assert ctx.user_id == "u1"
+            return payload.upper()
+
+        async def add_async(ctx, payload):
+            return str(int(payload) + 1)
+
+        initializer.register_rpc("shout", shout)
+        initializer.register_rpc("add", add_async)
+
+    server = await make_server([init_module])
+    try:
+        c = await Client.connect(server, "u1", "alice")
+        await c.send({"cid": "1", "rpc": {"id": "shout", "payload": "hey"}})
+        out = await c.recv("rpc")
+        assert out["rpc"]["payload"] == "HEY"
+
+        await c.send({"cid": "2", "rpc": {"id": "add", "payload": "41"}})
+        out = await c.recv("rpc")
+        assert out["rpc"]["payload"] == "42"
+
+        await c.send({"cid": "3", "rpc": {"id": "nope", "payload": ""}})
+        err = await c.recv("error")
+        assert "not found" in err["error"]["message"].lower()
+        await c.close()
+    finally:
+        await server.stop(0)
+
+
+# -------------------------------------------------------- before/after RT
+
+
+async def test_before_hook_mutates_and_rejects():
+    seen_after = []
+
+    def init_module(ctx, logger, nk, initializer):
+        def before_add(ctx, key, body):
+            if (body.get("query") or "") == "forbidden":
+                return None  # silent rejection
+            body = dict(body)
+            # Force every ticket into a fixed mode (hook mutation).
+            body["string_properties"] = {"mode": "forced"}
+            body["query"] = "+properties.mode:forced"
+            return body
+
+        initializer.register_before_rt("matchmaker_add", before_add)
+        initializer.register_after_rt(
+            "matchmaker_add", lambda c, k, b: seen_after.append(b)
+        )
+
+    server = await make_server([init_module])
+    try:
+        a = await Client.connect(server, "u1", "alice")
+        b = await Client.connect(server, "u2", "bob")
+        # Rejected add: no ticket envelope comes back.
+        await a.send(
+            {
+                "cid": "x",
+                "matchmaker_add": {
+                    "min_count": 2,
+                    "max_count": 2,
+                    "query": "forbidden",
+                },
+            }
+        )
+        with pytest.raises(asyncio.TimeoutError):
+            await a.recv("matchmaker_ticket", timeout=0.3)
+
+        # Mutated adds: different queries, but the hook forces one mode so
+        # they match each other.
+        for c, q in ((a, "+properties.mode:alpha"), (b, "+properties.mode:beta")):
+            await c.send(
+                {
+                    "cid": "mm",
+                    "matchmaker_add": {
+                        "min_count": 2,
+                        "max_count": 2,
+                        "query": q,
+                        "string_properties": {"mode": "original"},
+                    },
+                }
+            )
+            await c.recv("matchmaker_ticket")
+        server.matchmaker.process()
+        ma = await a.recv("matchmaker_matched")
+        mb = await b.recv("matchmaker_matched")
+        assert ma["matchmaker_matched"]["token"]
+        assert mb["matchmaker_matched"]["token"]
+        assert len(seen_after) == 2
+        await a.close()
+        await b.close()
+    finally:
+        await server.stop(0)
+
+
+# --------------------------------------------------- matchmaker override
+
+
+async def test_matchmaker_override_picks_matches():
+    chosen_log = []
+
+    def init_module(ctx, logger, nk, initializer):
+        def override(ctx, candidates):
+            # Form only the first candidate combination; drop the rest
+            # (reference processCustom → matchmakerOverrideFunction).
+            chosen_log.append(len(candidates))
+            return candidates[:1]
+
+        initializer.register_matchmaker_override(override)
+
+    server = await make_server([init_module])
+    try:
+        clients = []
+        for i in range(4):
+            c = await Client.connect(server, f"u{i}", f"user{i}")
+            clients.append(c)
+            await c.send(
+                {
+                    "cid": "mm",
+                    "matchmaker_add": {
+                        "min_count": 2,
+                        "max_count": 2,
+                        "query": "*",
+                    },
+                }
+            )
+            await c.recv("matchmaker_ticket")
+        server.matchmaker.process()
+        # Exactly one pair (2 of 4 users) was formed by the override.
+        matched_users = 0
+        for c in clients:
+            try:
+                await c.recv("matchmaker_matched", timeout=0.5)
+                matched_users += 1
+            except asyncio.TimeoutError:
+                pass
+        assert matched_users == 2
+        assert chosen_log and chosen_log[0] >= 1
+        for c in clients:
+            await c.close()
+    finally:
+        await server.stop(0)
+
+
+# ------------------------------------------- registered match + matched
+
+
+async def test_registered_match_and_matched_hook():
+    """A module registers an authoritative match handler AND a
+    matchmaker_matched hook that creates one — matched players receive a
+    match_id instead of a token (reference runtime.go:3298 flow)."""
+
+    def init_module(ctx, logger, nk, initializer):
+        class ArenaMatch:
+            def match_init(self, ctx, params):
+                return {"joined": 0}, 10, "arena"
+
+            def match_join_attempt(self, ctx, d, tick, state, presence, md):
+                return state, True, ""
+
+            def match_join(self, ctx, d, tick, state, presences):
+                state["joined"] += len(presences)
+                return state
+
+            def match_leave(self, ctx, d, tick, state, presences):
+                return state
+
+            def match_loop(self, ctx, d, tick, state, messages):
+                return state
+
+            def match_terminate(self, ctx, d, tick, state, grace):
+                return state
+
+            def match_signal(self, ctx, d, tick, state, data):
+                return state, ""
+
+        initializer.register_match("arena", ArenaMatch)
+
+        def matched(ctx, entries):
+            return nk.match_create("arena", {"from": "matchmaker"})
+
+        initializer.register_matchmaker_matched(matched)
+
+    server = await make_server([init_module])
+    try:
+        a = await Client.connect(server, "u1", "alice")
+        b = await Client.connect(server, "u2", "bob")
+        for c in (a, b):
+            await c.send(
+                {
+                    "cid": "mm",
+                    "matchmaker_add": {
+                        "min_count": 2,
+                        "max_count": 2,
+                        "query": "*",
+                    },
+                }
+            )
+            await c.recv("matchmaker_ticket")
+        server.matchmaker.process()
+        ma = (await a.recv("matchmaker_matched"))["matchmaker_matched"]
+        assert ma.get("match_id"), "matched hook should produce a match id"
+        # Join the authoritative match by id.
+        await a.send({"cid": "j", "match_join": {"match_id": ma["match_id"]}})
+        match = (await a.recv("match"))["match"]
+        assert match["authoritative"] is True
+        assert match["label"] == "arena"
+        await a.close()
+        await b.close()
+    finally:
+        await server.stop(0)
+
+
+# ------------------------------------------------------- session events
+
+
+async def test_session_events_and_nk_storage():
+    events = []
+
+    def init_module(ctx, logger, nk, initializer):
+        initializer.register_event_session_start(
+            lambda ctx, t: events.append(("start", ctx.user_id))
+        )
+        initializer.register_event_session_end(
+            lambda ctx, r: events.append(("end", ctx.user_id))
+        )
+
+        async def save(ctx, payload):
+            await nk.storage_write(
+                [
+                    {
+                        "collection": "saves",
+                        "key": "slot1",
+                        "user_id": ctx.user_id,
+                        "value": payload,
+                    }
+                ]
+            )
+            objs = await nk.storage_read(
+                [
+                    {
+                        "collection": "saves",
+                        "key": "slot1",
+                        "user_id": ctx.user_id,
+                    }
+                ]
+            )
+            return objs[0]["value"]
+
+        initializer.register_rpc("save", save)
+
+    server = await make_server([init_module])
+    try:
+        c = await Client.connect(server, "u1", "alice")
+        await c.send(
+            {"cid": "1", "rpc": {"id": "save", "payload": '{"gold": 5}'}}
+        )
+        out = await c.recv("rpc")
+        assert json.loads(out["rpc"]["payload"]) == {"gold": 5}
+        await c.close()
+        for _ in range(50):
+            if ("end", "u1") in events:
+                break
+            await asyncio.sleep(0.05)
+        assert ("start", "u1") in events
+        assert ("end", "u1") in events
+    finally:
+        await server.stop(0)
