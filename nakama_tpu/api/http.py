@@ -1,0 +1,1180 @@
+"""HTTP request/response API + the /ws socket on one port.
+
+Parity with the reference ApiServer (reference server/api.go:87-226): the
+client API surface of apigrpc/apigrpc.proto exposed over REST exactly as
+the reference's grpc-gateway maps it — same routes, same auth model
+(server-key basic auth for authenticate/refresh, bearer session JWT for
+everything else, http_key for server-to-server RPC), the same
+before/after request-hook wrapping per method (reference api_*.go
+handlers), and the WebSocket acceptor mounted at /ws on the same port
+(reference socket_ws.go via api.go:213).
+
+The reference fronts gRPC with a gateway; a TPU-host framework has no
+gRPC ecosystem requirement, so the REST surface is the contract and the
+wire format is JSON throughout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+from typing import Any
+
+from aiohttp import WSMsgType, web
+
+from ..core import account as core_account
+from ..core import authenticate as core_auth
+from ..core import link as core_link
+from ..core import storage as core_storage
+from ..core.authenticate import AuthError
+from ..core.storage import (
+    StorageError,
+    StorageOpDelete,
+    StorageOpRead,
+    StorageOpWrite,
+    StoragePermissionError,
+    StorageVersionError,
+)
+from . import session_token
+
+GRPC_UNAUTHENTICATED = 16
+GRPC_PERMISSION_DENIED = 7
+GRPC_NOT_FOUND = 5
+GRPC_ALREADY_EXISTS = 6
+GRPC_INVALID_ARGUMENT = 3
+GRPC_INTERNAL = 13
+GRPC_UNIMPLEMENTED = 12
+
+_AUTH_CODE_TO_HTTP = {
+    "not_found": (404, GRPC_NOT_FOUND),
+    "already_exists": (409, GRPC_ALREADY_EXISTS),
+    "unauthenticated": (401, GRPC_UNAUTHENTICATED),
+    "permission_denied": (403, GRPC_PERMISSION_DENIED),
+}
+
+
+class ApiError(Exception):
+    def __init__(self, message: str, status: int, grpc_code: int):
+        super().__init__(message)
+        self.status = status
+        self.grpc_code = grpc_code
+
+
+def _error_response(message: str, status: int, grpc_code: int):
+    return web.json_response(
+        {"error": message, "message": message, "code": grpc_code},
+        status=status,
+    )
+
+
+class _WsAdapter:
+    """Presents aiohttp's WebSocketResponse with the `websockets`-library
+    surface the SocketAcceptor/WebSocketSession expect: `request.path`,
+    `send(str)`, `close(code, reason)`, and text-frame iteration."""
+
+    class _Req:
+        def __init__(self, path: str):
+            self.path = path
+
+    def __init__(self, ws: web.WebSocketResponse, path_qs: str):
+        self._ws = ws
+        self.request = self._Req(path_qs)
+
+    async def send(self, data: str):
+        await self._ws.send_str(data)
+
+    async def close(self, code: int = 1000, reason: str = ""):
+        await self._ws.close(code=code, message=reason.encode())
+
+    def __aiter__(self):
+        return self._iter()
+
+    async def _iter(self):
+        async for msg in self._ws:
+            if msg.type == WSMsgType.TEXT:
+                yield msg.data
+            elif msg.type in (WSMsgType.ERROR, WSMsgType.CLOSE):
+                return
+
+
+class ApiServer:
+    """Routes + auth middleware over the NakamaServer's components."""
+
+    def __init__(self, server):
+        self.server = server
+        self.config = server.config
+        self.logger = server.logger.with_fields(subsystem="api")
+        self.app = web.Application(
+            client_max_size=self.config.socket.max_request_size_bytes
+        )
+        self._runner: web.AppRunner | None = None
+        self._site = None
+        self.port: int | None = None
+        r = self.app.router
+        r.add_get("/", self._h_index)
+        r.add_get("/healthcheck", self._h_healthcheck)
+        r.add_get("/v2/healthcheck", self._h_healthcheck)
+        r.add_get("/ws", self._h_ws)
+
+        for provider in (
+            "device", "email", "custom", "apple", "facebook",
+            "facebookinstantgame", "gamecenter", "google", "steam",
+        ):
+            r.add_post(
+                f"/v2/account/authenticate/{provider}",
+                self._make_authenticate(provider),
+            )
+            if provider != "facebookinstantgame":
+                link_name = provider
+                r.add_post(
+                    f"/v2/account/link/{link_name}",
+                    self._make_link(link_name, linking=True),
+                )
+                r.add_post(
+                    f"/v2/account/unlink/{link_name}",
+                    self._make_link(link_name, linking=False),
+                )
+        r.add_post("/v2/account/session/refresh", self._h_session_refresh)
+        r.add_post("/v2/session/logout", self._h_session_logout)
+        r.add_get("/v2/account", self._h_account_get)
+        r.add_put("/v2/account", self._h_account_update)
+        r.add_delete("/v2/account", self._h_account_delete)
+        r.add_get("/v2/user", self._h_users_get)
+
+        r.add_post("/v2/storage", self._h_storage_read)
+        r.add_put("/v2/storage", self._h_storage_write)
+        r.add_put("/v2/storage/delete", self._h_storage_delete)
+        r.add_get("/v2/storage/{collection}", self._h_storage_list)
+        r.add_get(
+            "/v2/storage/{collection}/{user_id}", self._h_storage_list
+        )
+
+        r.add_post("/v2/rpc/{id}", self._h_rpc)
+        r.add_get("/v2/rpc/{id}", self._h_rpc)
+        r.add_post("/v2/event", self._h_event)
+        r.add_get("/v2/match", self._h_match_list)
+
+        r.add_get("/v2/leaderboard/{id}", self._h_lb_records_list)
+        r.add_post("/v2/leaderboard/{id}", self._h_lb_record_write)
+        r.add_delete("/v2/leaderboard/{id}", self._h_lb_record_delete)
+        r.add_get(
+            "/v2/leaderboard/{id}/owner/{owner_id}", self._h_lb_haystack
+        )
+        r.add_get("/v2/channel/{channel_id}", self._h_channel_messages)
+        r.add_get("/v2/tournament", self._h_tournament_list)
+        r.add_get("/v2/tournament/{id}", self._h_t_records_list)
+        r.add_post("/v2/tournament/{id}", self._h_t_record_write)
+        r.add_post("/v2/tournament/{id}/join", self._h_t_join)
+        r.add_get(
+            "/v2/tournament/{id}/owner/{owner_id}", self._h_lb_haystack
+        )
+
+        r.add_get("/v2/friend", self._h_friend_list)
+        r.add_post("/v2/friend", self._h_friend_add)
+        r.add_delete("/v2/friend", self._h_friend_delete)
+        r.add_post("/v2/friend/block", self._h_friend_block)
+
+        r.add_get("/v2/group", self._h_group_list)
+        r.add_post("/v2/group", self._h_group_create)
+        r.add_put("/v2/group/{group_id}", self._h_group_update)
+        r.add_delete("/v2/group/{group_id}", self._h_group_delete)
+        r.add_get("/v2/group/{group_id}/user", self._h_group_users)
+        r.add_get("/v2/user/{user_id}/group", self._h_user_groups)
+        for action in ("join", "leave", "add", "kick", "ban", "promote",
+                       "demote"):
+            r.add_post(
+                f"/v2/group/{{group_id}}/{action}",
+                self._make_group_action(action),
+            )
+
+        # Surfaces whose cores land with their subsystems; the route shape
+        # is reserved now so clients get a structured UNIMPLEMENTED, not 404
+        # (reference: apigrpc.proto full rpc list).
+        for method, path in (
+            ("GET", "/v2/notification"),
+        ):
+            r.add_route(method, path, self._h_unimplemented)
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self, host: str, port: int) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, host, port)
+        await self._site.start()
+        self.port = self._site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ---------------------------------------------------------------- auth
+
+    def _check_server_key(self, request: web.Request):
+        """Basic auth with the server key (reference api.go:101
+        securityInterceptorFunc for authenticate methods)."""
+        header = request.headers.get("Authorization", "")
+        if header.startswith("Basic "):
+            try:
+                decoded = base64.b64decode(header[6:]).decode()
+            except Exception:
+                decoded = ""
+            key = decoded.split(":", 1)[0]
+            if key == self.config.socket.server_key:
+                return
+        raise ApiError(
+            "server key required", 401, GRPC_UNAUTHENTICATED
+        )
+
+    def _session(self, request: web.Request) -> session_token.SessionClaims:
+        header = request.headers.get("Authorization", "")
+        token = header[7:] if header.startswith("Bearer ") else ""
+        if not token:
+            token = request.query.get("token", "")
+        if not token:
+            raise ApiError(
+                "auth token required", 401, GRPC_UNAUTHENTICATED
+            )
+        try:
+            claims = session_token.parse(
+                self.config.session.encryption_key, token
+            )
+        except session_token.TokenError as e:
+            raise ApiError(str(e), 401, GRPC_UNAUTHENTICATED)
+        if not self.server.session_cache.is_valid_session(
+            claims.user_id, claims.token_id
+        ):
+            raise ApiError(
+                "session invalidated", 401, GRPC_UNAUTHENTICATED
+            )
+        return claims
+
+    def _issue_tokens(
+        self, user_id: str, username: str, vars: dict | None = None
+    ) -> dict:
+        sc = self.config.session
+        token, claims = session_token.generate(
+            sc.encryption_key,
+            user_id,
+            username,
+            sc.token_expiry_sec,
+            vars=vars or {},
+        )
+        refresh, rclaims = session_token.generate(
+            sc.refresh_encryption_key,
+            user_id,
+            username,
+            sc.refresh_token_expiry_sec,
+            vars=vars or {},
+        )
+        self.server.session_cache.add(
+            user_id,
+            claims.expires_at,
+            claims.token_id,
+            rclaims.expires_at,
+            rclaims.token_id,
+        )
+        return {"token": token, "refresh_token": refresh}
+
+    # ----------------------------------------------------------- wrapping
+
+    async def _json(self, request: web.Request) -> dict:
+        if not request.can_read_body:
+            return {}
+        try:
+            body = await request.json()
+        except Exception:
+            raise ApiError(
+                "invalid JSON body", 400, GRPC_INVALID_ARGUMENT
+            )
+        return body if isinstance(body, dict) else {}
+
+    async def _hooked(
+        self, method: str, ctx_claims, body: dict
+    ) -> dict | None:
+        """Run the before-request hook; None = rejected (reference
+        api_*.go: a nil return from a before hook aborts with 404/403)."""
+        runtime = self.server.runtime
+        if runtime is None:
+            return body
+        fn = runtime.before_req(method)
+        if fn is None:
+            return body
+        ctx = runtime.context(mode="before")
+        if ctx_claims is not None:
+            ctx.user_id = ctx_claims.user_id
+            ctx.username = ctx_claims.username
+            ctx.vars = ctx_claims.vars
+        result = fn(ctx, body)
+        if asyncio.iscoroutine(result):
+            result = await result
+        return result
+
+    async def _after(self, method: str, ctx_claims, body: dict, result):
+        runtime = self.server.runtime
+        if runtime is None:
+            return
+        fn = runtime.after_req(method)
+        if fn is None:
+            return
+        ctx = runtime.context(mode="after")
+        if ctx_claims is not None:
+            ctx.user_id = ctx_claims.user_id
+            ctx.username = ctx_claims.username
+        try:
+            out = fn(ctx, body, result)
+            if asyncio.iscoroutine(out):
+                await out
+        except Exception as e:
+            self.logger.error(
+                "after hook error", method=method, error=str(e)
+            )
+
+    # ------------------------------------------------------------- basics
+
+    async def _h_index(self, request):
+        return web.json_response({"name": self.config.name})
+
+    async def _h_healthcheck(self, request):
+        # DB reachability is the health signal (reference Healthcheck).
+        try:
+            await self.server.db.fetch_one("SELECT 1")
+        except Exception as e:
+            return _error_response(str(e), 500, GRPC_INTERNAL)
+        return web.json_response({})
+
+    async def _h_unimplemented(self, request):
+        return _error_response(
+            "not yet implemented", 501, GRPC_UNIMPLEMENTED
+        )
+
+    async def _h_ws(self, request: web.Request):
+        ws = web.WebSocketResponse(
+            heartbeat=self.config.socket.ping_period_ms / 1000.0,
+            max_msg_size=self.config.socket.max_message_size_bytes,
+        )
+        await ws.prepare(request)
+        adapter = _WsAdapter(ws, request.path_qs)
+        await self.server.acceptor.handle(adapter)
+        return ws
+
+    # ----------------------------------------------------- authentication
+
+    def _make_authenticate(self, provider: str):
+        async def handler(request: web.Request):
+            try:
+                self._check_server_key(request)
+                body = await self._json(request)
+                method = f"authenticate{provider}"
+                body = await self._hooked(method, None, body)
+                if body is None:
+                    raise ApiError(
+                        "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                    )
+                create = _parse_bool(
+                    request.query.get("create", body.get("create", True))
+                )
+                username = request.query.get(
+                    "username", body.get("username", "")
+                )
+                account = body.get("account", body)
+                db = self.server.db
+                if provider == "device":
+                    user_id, uname, created = (
+                        await core_auth.authenticate_device(
+                            db, account.get("id", ""), username, create
+                        )
+                    )
+                elif provider == "email":
+                    user_id, uname, created = (
+                        await core_auth.authenticate_email(
+                            db,
+                            account.get("email", ""),
+                            account.get("password", ""),
+                            username,
+                            create,
+                        )
+                    )
+                elif provider == "custom":
+                    user_id, uname, created = (
+                        await core_auth.authenticate_custom(
+                            db, account.get("id", ""), username, create
+                        )
+                    )
+                else:
+                    social = self.server.social
+                    if social is None:
+                        raise ApiError(
+                            f"{provider} authentication not configured",
+                            501,
+                            GRPC_UNIMPLEMENTED,
+                        )
+                    fn = getattr(core_auth, f"authenticate_{provider}", None)
+                    if provider == "facebookinstantgame":
+                        fn = core_auth.authenticate_facebook_instant
+                    user_id, uname, created = await fn(
+                        db, social, account, username, create
+                    )
+                result = {
+                    "created": created,
+                    **self._issue_tokens(
+                        user_id, uname, body.get("vars") or {}
+                    ),
+                }
+                await self._after(method, None, body, result)
+                return web.json_response(result)
+            except Exception as e:
+                return self._map_error(e)
+
+        return handler
+
+    async def _h_session_refresh(self, request: web.Request):
+        try:
+            self._check_server_key(request)
+            body = await self._json(request)
+            sc = self.config.session
+            try:
+                claims = session_token.parse(
+                    sc.refresh_encryption_key, body.get("token", "")
+                )
+            except session_token.TokenError as e:
+                raise ApiError(str(e), 401, GRPC_UNAUTHENTICATED)
+            cache = self.server.session_cache
+            if not cache.is_valid_refresh(claims.user_id, claims.token_id):
+                raise ApiError(
+                    "refresh token invalidated", 401, GRPC_UNAUTHENTICATED
+                )
+            # Rotation kills only the USED refresh token; live sessions on
+            # other devices keep working and short-lived session tokens
+            # age out naturally (reference SessionRefresh semantics).
+            cache.remove_refresh(claims.user_id, claims.token_id)
+            vars = dict(claims.vars)
+            vars.update(body.get("vars") or {})
+            result = {
+                "created": False,
+                **self._issue_tokens(claims.user_id, claims.username, vars),
+            }
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_session_logout(self, request: web.Request):
+        """Invalidate the presented session (+ the refresh token in the
+        body, if given) — NOT every device's sessions (reference
+        SessionLogout api_account.go)."""
+        try:
+            claims = self._session(request)
+            cache = self.server.session_cache
+            cache.remove_session(claims.user_id, claims.token_id)
+            body = await self._json(request)
+            refresh = body.get("refresh_token", "")
+            if refresh:
+                try:
+                    rclaims = session_token.parse(
+                        self.config.session.refresh_encryption_key, refresh
+                    )
+                    cache.remove_refresh(
+                        rclaims.user_id, rclaims.token_id
+                    )
+                except session_token.TokenError:
+                    pass
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    # ------------------------------------------------------------ account
+
+    async def _h_account_get(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            account = await core_account.get_account(
+                self.server.db, claims.user_id
+            )
+            return web.json_response(account)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_account_update(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            body2 = await self._hooked("updateaccount", claims, body)
+            if body2 is None:
+                raise ApiError(
+                    "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                )
+            body = body2
+            await core_account.update_account(
+                self.server.db,
+                claims.user_id,
+                username=body.get("username"),
+                display_name=body.get("display_name"),
+                timezone=body.get("timezone"),
+                location=body.get("location"),
+                lang_tag=body.get("lang_tag"),
+                avatar_url=body.get("avatar_url"),
+            )
+            await self._after("updateaccount", claims, body, {})
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_account_delete(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            await core_account.delete_account(
+                self.server.db, claims.user_id, recorded=True
+            )
+            self.server.session_cache.remove_all(claims.user_id)
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_users_get(self, request: web.Request):
+        try:
+            self._session(request)
+            ids = request.query.getall("ids", [])
+            usernames = request.query.getall("usernames", [])
+            users = await core_account.get_users(
+                self.server.db, user_ids=ids, usernames=usernames
+            )
+            return web.json_response({"users": users})
+        except Exception as e:
+            return self._map_error(e)
+
+    # ------------------------------------------------------- link/unlink
+
+    def _make_link(self, provider: str, linking: bool):
+        async def handler(request: web.Request):
+            try:
+                claims = self._session(request)
+                body = await self._json(request)
+                db = self.server.db
+                uid = claims.user_id
+                if provider == "device":
+                    if linking:
+                        await core_link.link_device(db, uid, body.get("id", ""))
+                    else:
+                        await core_link.unlink_device(
+                            db, uid, body.get("id", "")
+                        )
+                elif provider == "email":
+                    if linking:
+                        await core_link.link_email(
+                            db,
+                            uid,
+                            body.get("email", ""),
+                            body.get("password", ""),
+                        )
+                    else:
+                        await core_link.unlink_email(db, uid)
+                elif provider == "custom":
+                    if linking:
+                        await core_link.link_custom(db, uid, body.get("id", ""))
+                    else:
+                        await core_link.unlink_custom(db, uid)
+                else:
+                    social = self.server.social
+                    fn = getattr(
+                        core_link,
+                        f"{'link' if linking else 'unlink'}_{provider}",
+                        None,
+                    )
+                    if fn is None or social is None:
+                        raise ApiError(
+                            f"{provider} linking not configured",
+                            501,
+                            GRPC_UNIMPLEMENTED,
+                        )
+                    if linking:
+                        await fn(db, uid, social, body)
+                    else:
+                        await fn(db, uid)
+                return web.json_response({})
+            except Exception as e:
+                return self._map_error(e)
+
+        return handler
+
+    # ------------------------------------------------------------ storage
+
+    async def _h_storage_read(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            ops = [
+                StorageOpRead(
+                    collection=o.get("collection", ""),
+                    key=o.get("key", ""),
+                    user_id=o.get("user_id") or claims.user_id,
+                )
+                for o in body.get("object_ids", [])
+            ]
+            objects = await core_storage.storage_read_objects(
+                self.server.db, claims.user_id, ops
+            )
+            return web.json_response(
+                {"objects": [o.as_dict() for o in objects]}
+            )
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_storage_write(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            ops = []
+            for o in body.get("objects", []):
+                value = o.get("value", "")
+                if not isinstance(value, str):
+                    value = json.dumps(value)
+                ops.append(
+                    StorageOpWrite(
+                        collection=o.get("collection", ""),
+                        key=o.get("key", ""),
+                        user_id=claims.user_id,
+                        value=value,
+                        version=o.get("version", ""),
+                        permission_read=int(o.get("permission_read", 1)),
+                        permission_write=int(o.get("permission_write", 1)),
+                    )
+                )
+            acks = await core_storage.storage_write_objects(
+                self.server.db, claims.user_id, ops
+            )
+            return web.json_response(
+                {
+                    "acks": [
+                        {
+                            "collection": a.collection,
+                            "key": a.key,
+                            "user_id": a.user_id,
+                            "version": a.version,
+                        }
+                        for a in acks
+                    ]
+                }
+            )
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_storage_delete(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            ops = [
+                StorageOpDelete(
+                    collection=o.get("collection", ""),
+                    key=o.get("key", ""),
+                    user_id=claims.user_id,
+                    version=o.get("version", ""),
+                )
+                for o in body.get("object_ids", [])
+            ]
+            await core_storage.storage_delete_objects(
+                self.server.db, claims.user_id, ops
+            )
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_storage_list(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            collection = request.match_info["collection"]
+            user_id = request.match_info.get(
+                "user_id", request.query.get("user_id", "")
+            )
+            objects, cursor = await core_storage.storage_list_objects(
+                self.server.db,
+                claims.user_id,
+                collection,
+                user_id=user_id or None,
+                limit=int(request.query.get("limit", 100)),
+                cursor=request.query.get("cursor", ""),
+            )
+            return web.json_response(
+                {
+                    "objects": [o.as_dict() for o in objects],
+                    "cursor": cursor,
+                }
+            )
+        except Exception as e:
+            return self._map_error(e)
+
+    # ---------------------------------------------------------------- rpc
+
+    async def _h_rpc(self, request: web.Request):
+        """HTTP RPC (reference api.go:217 /v2/rpc/{id} hijack): bearer
+        session auth, or the runtime http_key for server-to-server calls."""
+        try:
+            rpc_id = request.match_info["id"].lower()
+            runtime = self.server.runtime
+            if runtime is None:
+                raise ApiError("runtime not loaded", 501, GRPC_UNIMPLEMENTED)
+            fn = runtime.rpc(rpc_id)
+            if fn is None:
+                raise ApiError(
+                    f"RPC function not found: {rpc_id}",
+                    404,
+                    GRPC_NOT_FOUND,
+                )
+            http_key = request.query.get("http_key", "")
+            if http_key:
+                if http_key != self.config.runtime.http_key:
+                    raise ApiError(
+                        "invalid http key", 401, GRPC_UNAUTHENTICATED
+                    )
+                ctx = runtime.context(mode="rpc")
+            else:
+                claims = self._session(request)
+                ctx = runtime.context(
+                    mode="rpc",
+                    user_id=claims.user_id,
+                    username=claims.username,
+                    vars=claims.vars,
+                )
+            ctx.query_params = {
+                k: request.query.getall(k) for k in request.query
+            }
+            if request.method == "POST":
+                payload = await request.text()
+                # grpc-gateway unwraps a JSON-string body ("\"x\"" -> x).
+                if payload.startswith('"') and payload.endswith('"'):
+                    try:
+                        payload = json.loads(payload)
+                    except ValueError:
+                        pass
+            else:
+                payload = request.query.get("payload", "")
+            try:
+                result = fn(ctx, payload)
+                if asyncio.iscoroutine(result):
+                    result = await result
+            except Exception as e:
+                raise ApiError(str(e), 500, GRPC_INTERNAL)
+            return web.json_response(
+                {"id": rpc_id, "payload": result or ""}
+            )
+        except Exception as e:
+            return self._map_error(e)
+
+    # -------------------------------------------------------------- misc
+
+    async def _h_event(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            runtime = self.server.runtime
+            if runtime is not None:
+                ctx = runtime.context(
+                    mode="event",
+                    user_id=claims.user_id,
+                    username=claims.username,
+                )
+                runtime.fire_event(
+                    ctx,
+                    {
+                        "name": body.get("name", ""),
+                        "properties": body.get("properties") or {},
+                        "external": True,
+                    },
+                )
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_match_list(self, request: web.Request):
+        try:
+            self._session(request)
+            q = request.query
+            limit = int(q.get("limit", 10))
+            matches = self.server.match_registry.list_matches(
+                limit,
+                label=q.get("label") or None,
+                min_size=int(q["min_size"]) if "min_size" in q else None,
+                max_size=int(q["max_size"]) if "max_size" in q else None,
+                query=q.get("query") or None,
+            )
+            return web.json_response({"matches": matches})
+        except Exception as e:
+            return self._map_error(e)
+
+    # ----------------------------------------------------------- friends
+
+    async def _resolve_target_ids(self, request: web.Request) -> list[str]:
+        """ids= and usernames= query params to user ids (reference
+        fetchIds in api_friend.go)."""
+        ids = list(request.query.getall("ids", []))
+        usernames = request.query.getall("usernames", [])
+        if usernames:
+            users = await core_account.get_users(
+                self.server.db, usernames=usernames
+            )
+            ids.extend(u["id"] for u in users)
+        return ids
+
+    async def _h_friend_list(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            q = request.query
+            result = await self.server.friends.list(
+                claims.user_id,
+                limit=int(q.get("limit", 100)),
+                state=int(q["state"]) if "state" in q else None,
+                cursor=q.get("cursor", ""),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_friend_add(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            for fid in await self._resolve_target_ids(request):
+                await self.server.friends.add(
+                    claims.user_id, claims.username, fid
+                )
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_friend_delete(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            for fid in await self._resolve_target_ids(request):
+                await self.server.friends.delete(claims.user_id, fid)
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_friend_block(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            for fid in await self._resolve_target_ids(request):
+                await self.server.friends.block(
+                    claims.user_id, claims.username, fid
+                )
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    # ------------------------------------------------------------- groups
+
+    async def _h_group_create(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            group = await self.server.groups.create(
+                claims.user_id,
+                body.get("name", ""),
+                description=body.get("description", ""),
+                avatar_url=body.get("avatar_url", ""),
+                lang_tag=body.get("lang_tag", "en"),
+                metadata=body.get("metadata"),
+                open=bool(body.get("open", True)),
+                max_count=int(body.get("max_count", 100)),
+            )
+            return web.json_response(group)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_group_list(self, request: web.Request):
+        try:
+            self._session(request)
+            q = request.query
+            result = await self.server.groups.list(
+                name=q.get("name") or None,
+                limit=int(q.get("limit", 100)),
+                cursor=q.get("cursor", ""),
+                open=(
+                    _parse_bool(q["open"]) if "open" in q else None
+                ),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_group_update(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            await self.server.groups.update(
+                request.match_info["group_id"],
+                caller_id=claims.user_id,
+                name=body.get("name"),
+                description=body.get("description"),
+                avatar_url=body.get("avatar_url"),
+                lang_tag=body.get("lang_tag"),
+                metadata=body.get("metadata"),
+                open=body.get("open"),
+                max_count=body.get("max_count"),
+            )
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_group_delete(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            await self.server.groups.delete(
+                request.match_info["group_id"], caller_id=claims.user_id
+            )
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    def _make_group_action(self, action: str):
+        async def handler(request: web.Request):
+            try:
+                claims = self._session(request)
+                groups = self.server.groups
+                gid = request.match_info["group_id"]
+                if action == "join":
+                    await groups.join(gid, claims.user_id, claims.username)
+                elif action == "leave":
+                    await groups.leave(gid, claims.user_id)
+                else:
+                    user_ids = request.query.getall("user_ids", [])
+                    fn = getattr(groups, f"users_{action}")
+                    await fn(gid, user_ids, caller_id=claims.user_id)
+                return web.json_response({})
+            except Exception as e:
+                return self._map_error(e)
+
+        return handler
+
+    async def _h_group_users(self, request: web.Request):
+        try:
+            self._session(request)
+            q = request.query
+            result = await self.server.groups.users_list(
+                request.match_info["group_id"],
+                limit=int(q.get("limit", 100)),
+                state=int(q["state"]) if "state" in q else None,
+                cursor=q.get("cursor", ""),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_user_groups(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            q = request.query
+            user_id = request.match_info["user_id"] or claims.user_id
+            result = await self.server.groups.user_groups_list(
+                user_id,
+                limit=int(q.get("limit", 100)),
+                state=int(q["state"]) if "state" in q else None,
+                cursor=q.get("cursor", ""),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    # ----------------------------------------- leaderboards / tournaments
+
+    async def _h_lb_record_write(self, request: web.Request):
+        """Reference WriteLeaderboardRecord (api_leaderboard.go): client
+        writes are refused on authoritative boards."""
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            record = body.get("record", body)
+            result = await self.server.leaderboards.record_write(
+                request.match_info["id"],
+                claims.user_id,
+                claims.username,
+                int(record.get("score", 0)),
+                int(record.get("subscore", 0)),
+                record.get("metadata"),
+                override_operator=record.get("operator"),
+                caller_authoritative=False,
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_lb_records_list(self, request: web.Request):
+        try:
+            self._session(request)
+            q = request.query
+            result = await self.server.leaderboards.records_list(
+                request.match_info["id"],
+                limit=int(q.get("limit", 100)),
+                cursor=q.get("cursor", ""),
+                owner_ids=q.getall("owner_ids", []) or None,
+                expiry_override=(
+                    float(q["expiry"]) if "expiry" in q else None
+                ),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_lb_record_delete(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            await self.server.leaderboards.record_delete(
+                request.match_info["id"],
+                claims.user_id,
+                caller_authoritative=False,
+            )
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_lb_haystack(self, request: web.Request):
+        """Around-owner window (reference
+        ListLeaderboardRecordsAroundOwner)."""
+        try:
+            self._session(request)
+            result = await self.server.leaderboards.records_haystack(
+                request.match_info["id"],
+                request.match_info["owner_id"],
+                limit=int(request.query.get("limit", 100)),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_channel_messages(self, request: web.Request):
+        """Chat history (reference ListChannelMessages, api_channel.go:
+        group channels require membership, DMs require being a
+        participant; rooms are open)."""
+        try:
+            claims = self._session(request)
+            channel_id = request.match_info["channel_id"]
+            from ..core import group as group_mod
+            from ..core.channel import channel_id_to_stream
+            from ..realtime import StreamMode
+
+            stream = channel_id_to_stream(channel_id)
+            if stream.mode == StreamMode.DM:
+                if claims.user_id not in (stream.subject, stream.subcontext):
+                    raise ApiError(
+                        "not a participant in this conversation",
+                        403,
+                        GRPC_PERMISSION_DENIED,
+                    )
+            elif stream.mode == StreamMode.GROUP:
+                row = await self.server.db.fetch_one(
+                    "SELECT state FROM group_edge WHERE source_id = ?"
+                    " AND destination_id = ?",
+                    (stream.subject, claims.user_id),
+                )
+                state = None if row is None else row["state"]
+                if state not in (
+                    group_mod.SUPERADMIN, group_mod.ADMIN, group_mod.MEMBER
+                ):
+                    raise ApiError(
+                        "must be a group member", 403, GRPC_PERMISSION_DENIED
+                    )
+            q = request.query
+            result = await self.server.channels.messages_list(
+                channel_id,
+                limit=int(q.get("limit", 100)),
+                forward=_parse_bool(q.get("forward", "true")),
+                cursor=q.get("cursor", ""),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_tournament_list(self, request: web.Request):
+        try:
+            self._session(request)
+            q = request.query
+            categories = [int(c) for c in q.getall("category", [])]
+            return web.json_response(
+                {
+                    "tournaments": self.server.tournaments.list(
+                        categories=categories or None,
+                        active_only=_parse_bool(q.get("active", "false")),
+                    )
+                }
+            )
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_t_records_list(self, request: web.Request):
+        try:
+            self._session(request)
+            q = request.query
+            result = await self.server.tournaments.records_list(
+                request.match_info["id"],
+                limit=int(q.get("limit", 100)),
+                cursor=q.get("cursor", ""),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_t_record_write(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            record = body.get("record", body)
+            result = await self.server.tournaments.record_write(
+                request.match_info["id"],
+                claims.user_id,
+                claims.username,
+                int(record.get("score", 0)),
+                int(record.get("subscore", 0)),
+                record.get("metadata"),
+                caller_authoritative=False,
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_t_join(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            await self.server.tournaments.join(
+                request.match_info["id"], claims.user_id, claims.username
+            )
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
+    # ------------------------------------------------------------- errors
+
+    def _map_error(self, e: Exception) -> web.Response:
+        from ..core.channel import ChannelError
+        from ..core.friend import FriendError
+        from ..core.group import GroupError
+        from ..leaderboard import LeaderboardError
+
+        if isinstance(e, ApiError):
+            return _error_response(str(e), e.status, e.grpc_code)
+        if isinstance(
+            e,
+            (AuthError, ChannelError, FriendError, GroupError,
+             LeaderboardError),
+        ):
+            status, code = _AUTH_CODE_TO_HTTP.get(
+                getattr(e, "code", ""), (400, GRPC_INVALID_ARGUMENT)
+            )
+            return _error_response(str(e), status, code)
+        if isinstance(e, StorageVersionError):
+            return _error_response(str(e), 409, GRPC_ALREADY_EXISTS)
+        if isinstance(e, StoragePermissionError):
+            return _error_response(str(e), 403, GRPC_PERMISSION_DENIED)
+        if isinstance(e, StorageError):
+            return _error_response(str(e), 400, GRPC_INVALID_ARGUMENT)
+        if isinstance(e, (ValueError, KeyError)):
+            # Malformed client input (unparsable ints, bad cursors).
+            return _error_response(str(e), 400, GRPC_INVALID_ARGUMENT)
+        self.logger.error("api handler error", error=str(e))
+        return _error_response("internal error", 500, GRPC_INTERNAL)
+
+
+def _parse_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    return str(value).lower() in ("true", "1", "yes", "")

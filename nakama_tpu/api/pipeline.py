@@ -11,6 +11,7 @@ structured error rather than disconnecting.
 from __future__ import annotations
 
 import asyncio
+import json
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -34,6 +35,7 @@ class Components:
     match_registry: Any = None
     party_registry: Any = None
     channels: Any = None  # channel core module facade
+    groups: Any = None  # group core (channel-join membership gate)
     runtime: Any = None
     session_registry: Any = None
     metrics: Metrics | None = None
@@ -597,6 +599,208 @@ class Pipeline:
             )
         except PartyError as e:
             raise PipelineError(str(e)) from e
+
+    # ------------------------------------------------------------- channel
+
+    async def _h_channel_join(self, session, cid, body):
+        """Reference pipeline_channel.go channelJoin: map (type, target)
+        to a stream, track, answer with the channel + current presences."""
+        from ..core.channel import (
+            ChannelError,
+            channel_to_stream,
+            stream_to_channel_id,
+        )
+
+        channels = _require(self.c.channels, "channels")
+        try:
+            stream = channel_to_stream(
+                int(body.get("type", 0)),
+                str(body.get("target", "")),
+                session.user_id,
+            )
+        except ChannelError as e:
+            raise PipelineError(str(e)) from e
+        if stream.mode == StreamMode.GROUP and self.c.groups is not None:
+            # Group chat requires membership (reference
+            # pipeline_channel.go channelJoin group gate).
+            from ..core.group import ADMIN, MEMBER, SUPERADMIN
+
+            row = await self.c.groups.db.fetch_one(
+                "SELECT state FROM group_edge WHERE source_id = ?"
+                " AND destination_id = ?",
+                (stream.subject, session.user_id),
+            )
+            state = None if row is None else row["state"]
+            if state not in (SUPERADMIN, ADMIN, MEMBER):
+                raise PipelineError("must be a group member")
+        from ..realtime import Presence, PresenceID
+
+        presence = Presence(
+            id=PresenceID(self.c.config.name, session.id),
+            stream=stream,
+            user_id=session.user_id,
+            meta=PresenceMeta(
+                format=session.format,
+                username=session.username,
+                hidden=bool(body.get("hidden", False)),
+                persistence=bool(body.get("persistence", True)),
+            ),
+        )
+        existing = [
+            p.as_dict()
+            for p in self.c.tracker.list_by_stream(stream)
+            if not p.meta.hidden
+        ]
+        self.c.tracker.track(
+            session.id, stream, session.user_id, presence.meta
+        )
+        channel_id = stream_to_channel_id(stream)
+        out: dict = {
+            "channel": {
+                "id": channel_id,
+                "presences": existing,
+                "self": presence.as_dict(),
+            }
+        }
+
+        if stream.mode == StreamMode.CHANNEL:
+            out["channel"]["room_name"] = stream.label
+        elif stream.mode == StreamMode.GROUP:
+            out["channel"]["group_id"] = stream.subject
+        else:
+            out["channel"]["user_id_one"] = stream.subject
+            out["channel"]["user_id_two"] = stream.subcontext
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    def _h_channel_leave(self, session, cid, body):
+        from ..core.channel import ChannelError, channel_id_to_stream
+
+        try:
+            stream = channel_id_to_stream(body.get("channel_id", ""))
+        except ChannelError as e:
+            raise PipelineError(str(e)) from e
+        self.c.tracker.untrack(session.id, stream)
+        if cid:
+            session.send({"cid": cid})
+
+    def _in_channel(self, session, channel_id: str):
+        from ..core.channel import ChannelError, channel_id_to_stream
+
+        try:
+            stream = channel_id_to_stream(channel_id)
+        except ChannelError as e:
+            raise PipelineError(str(e)) from e
+        if self.c.tracker.get_by_stream_user(stream, session.id) is None:
+            raise PipelineError("must join channel before sending")
+        return stream
+
+    async def _h_channel_message_send(self, session, cid, body):
+        """Reference pipeline_channel.go channelMessageSend."""
+        from ..core.channel import ChannelError
+
+        channels = _require(self.c.channels, "channels")
+        channel_id = body.get("channel_id", "")
+        self._in_channel(session, channel_id)
+        content = body.get("content")
+        if isinstance(content, str):
+            try:
+                content = json.loads(content)
+            except ValueError:
+                content = None
+        if not isinstance(content, dict):
+            raise PipelineError("content must be a JSON object")
+        try:
+            message = await channels.message_send(
+                channel_id,
+                content,
+                sender_id=session.user_id,
+                sender_username=session.username,
+            )
+        except ChannelError as e:
+            raise PipelineError(str(e)) from e
+        out = {
+            "channel_message_ack": {
+                "channel_id": channel_id,
+                "message_id": message["message_id"],
+                "code": message["code"],
+                "username": session.username,
+                "create_time": message["create_time"],
+                "update_time": message["update_time"],
+                "persistent": message["persistent"],
+            }
+        }
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    async def _h_channel_message_update(self, session, cid, body):
+        from ..core.channel import ChannelError
+
+        channels = _require(self.c.channels, "channels")
+        channel_id = body.get("channel_id", "")
+        self._in_channel(session, channel_id)
+        content = body.get("content")
+        if isinstance(content, str):
+            try:
+                content = json.loads(content)
+            except ValueError:
+                content = None
+        if not isinstance(content, dict):
+            raise PipelineError("content must be a JSON object")
+        try:
+            message = await channels.message_update(
+                channel_id,
+                body.get("message_id", ""),
+                content,
+                sender_id=session.user_id,
+                sender_username=session.username,
+            )
+        except ChannelError as e:
+            raise PipelineError(str(e)) from e
+        out = {
+            "channel_message_ack": {
+                "channel_id": channel_id,
+                "message_id": message["message_id"],
+                "code": message["code"],
+                "username": session.username,
+                "update_time": message["update_time"],
+                "persistent": True,
+            }
+        }
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+
+    async def _h_channel_message_remove(self, session, cid, body):
+        from ..core.channel import ChannelError
+
+        channels = _require(self.c.channels, "channels")
+        channel_id = body.get("channel_id", "")
+        self._in_channel(session, channel_id)
+        try:
+            message = await channels.message_remove(
+                channel_id,
+                body.get("message_id", ""),
+                sender_id=session.user_id,
+                sender_username=session.username,
+            )
+        except ChannelError as e:
+            raise PipelineError(str(e)) from e
+        out = {
+            "channel_message_ack": {
+                "channel_id": channel_id,
+                "message_id": message["message_id"],
+                "code": message["code"],
+                "username": session.username,
+                "update_time": message["update_time"],
+                "persistent": True,
+            }
+        }
+        if cid:
+            out["cid"] = cid
+        session.send(out)
 
     # ----------------------------------------------------------------- rpc
 

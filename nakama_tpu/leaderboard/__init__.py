@@ -1,0 +1,18 @@
+"""Leaderboards, tournaments, rank cache, reset scheduler (reference
+server/leaderboard_cache.go, core_leaderboard.go, core_tournament.go,
+leaderboard_rank_cache.go, leaderboard_scheduler.go)."""
+
+from .core import Leaderboard, LeaderboardError, Leaderboards
+from .rank_cache import LeaderboardRankCache
+from .scheduler import LeaderboardScheduler
+from .tournament import TournamentError, Tournaments
+
+__all__ = [
+    "Leaderboard",
+    "LeaderboardError",
+    "LeaderboardRankCache",
+    "LeaderboardScheduler",
+    "Leaderboards",
+    "TournamentError",
+    "Tournaments",
+]

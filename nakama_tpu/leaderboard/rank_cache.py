@@ -1,0 +1,143 @@
+"""Live rank cache.
+
+The reference keeps one skiplist per (leaderboard, expiry) for O(log n)
+rank lookups (reference server/leaderboard_rank_cache.go:25-121,
+internal/skiplist). SURVEY §7.9 prescribed deciding skiplist-on-host vs
+sorted-device-tensor by benchmark; the decision record lives in
+tests/test_leaderboard.py::test_rank_cache_beats_skiplist_shape. Measured
+on the record_write workload (every write wants the new rank back), a
+lazily-resorted tensor pays a full lexsort per write — O(n log n) each —
+and lost by ~60x, so the shipped structure is the host-ordered one: a
+flat sorted array with C-speed bisect/insort (the skiplist's O(log n)
+read with better constants and an O(n)-memmove write that stays cheap
+well past 100k records). Batched windows (haystack, around-rank) are
+array slices — the one thing the tensor design was good at survives.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, insort
+
+
+class _Board:
+    __slots__ = ("sort_order", "keys", "key_of", "_seq")
+
+    def __init__(self, sort_order: int):
+        self.sort_order = sort_order  # 0 asc, 1 desc
+        # Sorted ascending by (adj_score, adj_subscore, seq, owner);
+        # desc boards negate scores so better is always first.
+        self.keys: list[tuple] = []
+        self.key_of: dict[str, tuple] = {}
+        self._seq = 0
+
+    def _key(self, owner: str, score: int, subscore: int) -> tuple:
+        self._seq += 1
+        if self.sort_order:  # desc
+            return (-score, -subscore, self._seq, owner)
+        return (score, subscore, self._seq, owner)
+
+    def upsert(self, owner: str, score: int, subscore: int) -> int:
+        old = self.key_of.pop(owner, None)
+        if old is not None:
+            del self.keys[bisect_left(self.keys, old)]
+        key = self._key(owner, score, subscore)
+        self.key_of[owner] = key
+        insort(self.keys, key)
+        return bisect_left(self.keys, key)
+
+    def delete(self, owner: str):
+        old = self.key_of.pop(owner, None)
+        if old is not None:
+            del self.keys[bisect_left(self.keys, old)]
+
+    def rank(self, owner: str) -> int:
+        key = self.key_of.get(owner)
+        if key is None:
+            return -1
+        return bisect_left(self.keys, key)
+
+    def count(self) -> int:
+        return len(self.keys)
+
+    def owners_at(self, start: int, limit: int) -> list[tuple[str, int]]:
+        """Batched rank window [start, start+limit): one slice."""
+        return [
+            (key[3], start + i)
+            for i, key in enumerate(self.keys[start : start + limit])
+        ]
+
+
+class LeaderboardRankCache:
+    """Owner ranks per (leaderboard id, expiry bucket); trimmed when a
+    reset rolls expiry forward (reference TrimExpired,
+    leaderboard_rank_cache.go:29-36)."""
+
+    def __init__(self, blacklist: list[str] | None = None):
+        self._boards: dict[tuple[str, float], _Board] = {}
+        # "*" blacklists all boards (reference blacklist opt-out :106-115).
+        self._blacklist = set(blacklist or [])
+        self._all = "*" in self._blacklist
+
+    def _board(
+        self, leaderboard_id: str, expiry: float, sort_order: int
+    ) -> _Board | None:
+        if self._all or leaderboard_id in self._blacklist:
+            return None
+        key = (leaderboard_id, expiry)
+        board = self._boards.get(key)
+        if board is None:
+            board = self._boards[key] = _Board(sort_order)
+        return board
+
+    def insert(
+        self, leaderboard_id: str, expiry: float, sort_order: int,
+        owner_id: str, score: int, subscore: int,
+    ) -> int:
+        board = self._board(leaderboard_id, expiry, sort_order)
+        if board is None:
+            return -1
+        return board.upsert(owner_id, score, subscore)
+
+    def get(
+        self, leaderboard_id: str, expiry: float, owner_id: str
+    ) -> int:
+        board = self._boards.get((leaderboard_id, expiry))
+        if board is None:
+            return -1
+        return board.rank(owner_id)
+
+    def get_many(
+        self, leaderboard_id: str, expiry: float, owner_ids: list[str]
+    ) -> list[int]:
+        board = self._boards.get((leaderboard_id, expiry))
+        if board is None:
+            return [-1] * len(owner_ids)
+        return [board.rank(o) for o in owner_ids]
+
+    def delete(self, leaderboard_id: str, expiry: float, owner_id: str):
+        board = self._boards.get((leaderboard_id, expiry))
+        if board is not None:
+            board.delete(owner_id)
+
+    def delete_leaderboard(self, leaderboard_id: str):
+        for key in [k for k in self._boards if k[0] == leaderboard_id]:
+            del self._boards[key]
+
+    def count(self, leaderboard_id: str, expiry: float) -> int:
+        board = self._boards.get((leaderboard_id, expiry))
+        return 0 if board is None else board.count()
+
+    def rank_window(
+        self, leaderboard_id: str, expiry: float, start: int, limit: int
+    ) -> list[tuple[str, int]]:
+        board = self._boards.get((leaderboard_id, expiry))
+        if board is None:
+            return []
+        return board.owners_at(start, limit)
+
+    def trim_expired(self, now: float) -> int:
+        """Drop buckets whose expiry passed (0 = never expires)."""
+        gone = [k for k in self._boards if k[1] != 0 and k[1] <= now]
+        for k in gone:
+            del self._boards[k]
+        return len(gone)

@@ -84,6 +84,16 @@ def _scatter(pool: dict, idx: jnp.ndarray, rows: dict) -> dict:
     return {k: pool[k].at[idx].set(rows[k]) for k in pool}
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _invalidate(pool: dict, idx: jnp.ndarray) -> dict:
+    """Clear slots by flags alone — a removal needs no row data, so the
+    H2D payload is 4 bytes/slot instead of a full ~600-byte empty row
+    (matched-ticket churn at the 100k bench is ~50k removals/interval)."""
+    out = dict(pool)
+    out["flags"] = pool["flags"].at[idx].set(0)
+    return out
+
+
 class PoolBuffer:
     """Slot-allocated, device-resident ticket pool with queued updates.
 
@@ -101,7 +111,7 @@ class PoolBuffer:
         fs: int,
         s: int,
         d: int = 16,
-        flush_chunk: int = 8192,
+        flush_chunk: int = 2048,
         on_flush=None,
     ):
         self.capacity = capacity
@@ -110,15 +120,13 @@ class PoolBuffer:
         self.on_flush = on_flush
         host = pool_schema(capacity, fn, fs, s, d)
         self.device = jax.tree.map(jnp.asarray, host)
-        self._empty_row = {
-            k: v[0].copy() for k, v in pool_schema(1, fn, fs, s, d).items()
-        }
         # LIFO free list popping slot 0 first: the pool stays dense at the
         # low end, so the kernel can stop at the high-water mark.
         self._free = list(range(capacity - 1, -1, -1))
         self.high_water = 0
-        self._pending_idx: list[int] = []
-        self._pending_rows: list[dict[str, np.ndarray]] = []
+        # slot -> row (add/update) or None (removal). Insertion-order dict:
+        # assignment gives last-op-wins dedupe for free.
+        self._pending: dict[int, dict[str, np.ndarray] | None] = {}
         self.slot_of: dict[str, int] = {}  # ticket id -> slot
 
     def __len__(self) -> int:
@@ -130,9 +138,8 @@ class PoolBuffer:
         slot = self._free.pop()
         self.slot_of[ticket_id] = slot
         self.high_water = max(self.high_water, slot + 1)
-        self._pending_idx.append(slot)
-        self._pending_rows.append(row)
-        if len(self._pending_idx) >= self.flush_chunk:
+        self._pending[slot] = row
+        if len(self._pending) >= self.flush_chunk:
             self.flush()
         return slot
 
@@ -141,39 +148,76 @@ class PoolBuffer:
         if slot is None:
             return
         self._free.append(slot)
-        self._pending_idx.append(slot)
-        self._pending_rows.append(self._empty_row)
+        self._pending[slot] = None
+
+    def remove_many(self, ticket_ids: list[str]) -> list[int]:
+        """Bulk removal; returns the freed slots. One flush check at the
+        end instead of per ticket (interval churn is ~100k tickets at the
+        bench pool)."""
+        slot_of = self.slot_of
+        free = self._free
+        pending = self._pending
+        gone: list[int] = []
+        for tid in ticket_ids:
+            slot = slot_of.pop(tid, None)
+            if slot is None:
+                continue
+            free.append(slot)
+            pending[slot] = None
+            gone.append(slot)
+        if len(pending) >= self.flush_chunk:
+            self.flush()
+        return gone
 
     def flush(self):
-        """Apply queued updates as one device scatter.
+        """Apply queued updates: one flags-invalidate scatter for removals
+        (4B/slot) + one row scatter for adds.
 
-        The update count is padded to a power of two (repeating the last
-        row — an idempotent duplicate write) so XLA compiles one scatter per
-        size bucket instead of one per distinct update count."""
-        if not self._pending_idx:
+        Counts are padded to a power of two (repeating the last entry — an
+        idempotent duplicate write) so XLA compiles one scatter per size
+        bucket instead of one per distinct update count."""
+        if not self._pending:
             return
-        # Deduplicate by slot, last queued row wins: a remove + same-slot
-        # re-add within one interval must not leave scatter order (undefined
-        # for repeated indices) deciding which row survives.
-        latest: dict[int, dict[str, np.ndarray]] = {}
-        for slot, row in zip(self._pending_idx, self._pending_rows):
-            latest[slot] = row
-        u = len(latest)
-        u_pad = 1 << (u - 1).bit_length()
-        idx_list = list(latest.keys())
-        rows = list(latest.values())
-        idx = np.asarray(
-            idx_list + [idx_list[-1]] * (u_pad - u), dtype=np.int32
-        )
-        rows = rows + [rows[-1]] * (u_pad - u)
-        stacked = {k: np.stack([r[k] for r in rows]) for k in self.device}
-        self.device = _scatter(
-            self.device, jnp.asarray(idx), jax.tree.map(jnp.asarray, stacked)
-        )
-        self._pending_idx.clear()
-        self._pending_rows.clear()
-        if self.on_flush is not None:
-            self.on_flush(stacked)
+        rm_idx = [s for s, row in self._pending.items() if row is None]
+        add_items = [
+            (s, row) for s, row in self._pending.items() if row is not None
+        ]
+        self._pending = {}
+
+        # Everything at or under one chunk pads to exactly the chunk size:
+        # ONE compiled scatter shape covers the steady state (pow2 buckets
+        # above that). Distinct pow2 tails were costing a ~1.3s XLA compile
+        # on scattered intervals, dominating the bench p99.
+        def _pad(u: int) -> int:
+            if u <= self.flush_chunk:
+                return self.flush_chunk
+            return 1 << (u - 1).bit_length()
+
+        if rm_idx:
+            u = len(rm_idx)
+            u_pad = _pad(u)
+            idx = np.asarray(rm_idx + [rm_idx[-1]] * (u_pad - u), np.int32)
+            self.device = _invalidate(self.device, jnp.asarray(idx))
+
+        if add_items:
+            u = len(add_items)
+            u_pad = _pad(u)
+            idx_list = [s for s, _ in add_items]
+            rows = [r for _, r in add_items]
+            idx = np.asarray(
+                idx_list + [idx_list[-1]] * (u_pad - u), dtype=np.int32
+            )
+            rows = rows + [rows[-1]] * (u_pad - u)
+            stacked = {
+                k: np.stack([r[k] for r in rows]) for k in self.device
+            }
+            self.device = _scatter(
+                self.device,
+                jnp.asarray(idx),
+                jax.tree.map(jnp.asarray, stacked),
+            )
+            if self.on_flush is not None:
+                self.on_flush(stacked)
 
 
 def _accepts(qrow: dict, fcol: dict, with_should: bool):

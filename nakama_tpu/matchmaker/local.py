@@ -17,6 +17,7 @@ NewLocalBenchMatchmaker (server/matchmaker_test.go:1697).
 from __future__ import annotations
 
 import asyncio
+import operator
 import time
 import uuid
 from typing import Callable, Protocol
@@ -142,6 +143,11 @@ class LocalMatchmaker:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        wait_idle = getattr(self.backend, "wait_idle", None)
+        if wait_idle is not None:
+            # No device fetch thread may outlive the server (XLA aborts if
+            # a transfer is in flight at interpreter teardown).
+            wait_idle(timeout=5.0)
 
     def start(self):
         """Spawn the per-interval processing task (reference
@@ -259,7 +265,8 @@ class LocalMatchmaker:
         """One matching interval (reference Process, matchmaker.go:282-441)."""
         t0 = time.perf_counter()
         actives = sorted(
-            self.active.values(), key=lambda t: (t.created_at, t.created_seq)
+            self.active.values(),
+            key=operator.attrgetter("created_at", "created_seq"),
         )
         if self.override_fn is not None:
             matched, expired = process_custom(
@@ -289,11 +296,21 @@ class LocalMatchmaker:
         # by an explicit removal between snapshot and now (possible only for
         # override fns that suspend); drop such sets defensively.
         confirmed: list[list[MatchmakerEntry]] = []
+        to_remove: list = []
+        taken: set[str] = set()
+        tickets_map = self.tickets
         for entry_set in matched:
-            if all(e.ticket in self.tickets for e in entry_set):
+            # `taken` guards against an override fn returning overlapping
+            # sets: the first set wins, later ones are dropped (matches the
+            # old unregister-as-you-go behaviour).
+            if all(
+                e.ticket in tickets_map and e.ticket not in taken
+                for e in entry_set
+            ):
                 confirmed.append(entry_set)
-                for e in entry_set:
-                    self._unregister(e.ticket)
+                taken.update(e.ticket for e in entry_set)
+                to_remove.extend(entry_set)
+        self._unregister_entries(to_remove)
 
         if self.metrics is not None:
             self.metrics.mm_process_time.observe(time.perf_counter() - t0)
@@ -314,6 +331,10 @@ class LocalMatchmaker:
             return
         self.active.pop(ticket_id, None)
         self.backend.on_remove(ticket_id)
+        self._drop_owner_maps(ticket)
+
+    def _drop_owner_maps(self, ticket: MatchmakerTicket):
+        ticket_id = ticket.ticket
         for sid in ticket.session_ids:
             tickets = self.session_tickets.get(sid)
             if tickets is not None:
@@ -326,6 +347,27 @@ class LocalMatchmaker:
                 tickets.discard(ticket_id)
                 if not tickets:
                     del self.party_tickets[ticket.party_id]
+
+    def _unregister_entries(self, entries: list[MatchmakerEntry]):
+        """Bulk form of _unregister for interval churn (~100k matched
+        entries/interval at the bench pool): one backend batch call, local
+        dict maintenance inlined."""
+        tickets_map = self.tickets
+        active = self.active
+        removed_ids: list[str] = []
+        for e in entries:
+            ticket = tickets_map.pop(e.ticket, None)
+            if ticket is None:
+                continue
+            active.pop(e.ticket, None)
+            removed_ids.append(e.ticket)
+            self._drop_owner_maps(ticket)
+        remove_many = getattr(self.backend, "on_remove_many", None)
+        if remove_many is not None:
+            remove_many(removed_ids)
+        else:
+            for tid in removed_ids:
+                self.backend.on_remove(tid)
 
     def remove_session(self, session_id: str, ticket_id: str):
         """Ownership-checked removal (reference matchmaker.go:725)."""

@@ -63,6 +63,9 @@ class LocalSessionCache:
     def remove_session(self, user_id: str, session_token_id: str):
         self._session_tokens.get(user_id, {}).pop(session_token_id, None)
 
+    def remove_refresh(self, user_id: str, refresh_token_id: str):
+        self._refresh_tokens.get(user_id, {}).pop(refresh_token_id, None)
+
     def remove_all(self, user_id: str):
         self._session_tokens.pop(user_id, None)
         self._refresh_tokens.pop(user_id, None)

@@ -11,8 +11,6 @@ from __future__ import annotations
 import asyncio
 import signal
 
-import websockets
-
 from .api.matchmaker_events import make_matched_handler
 from .api.pipeline import Components, Pipeline
 from .api.socket import SocketAcceptor
@@ -109,6 +107,13 @@ class NakamaServer:
         self.tracker.add_listener(
             StreamMode.PARTY, self.party_registry.join_listener()
         )
+        from .core.channel import Channels
+        from .core.friend import Friends
+        from .core.group import Groups
+
+        self.channels = Channels(log, self.db, self.router)
+        self.friends = Friends(log, self.db)
+        self.groups = Groups(log, self.db)
         self.pipeline = Pipeline(
             log,
             Components(
@@ -120,6 +125,8 @@ class NakamaServer:
                 match_registry=self.match_registry,
                 party_registry=self.party_registry,
                 session_registry=self.session_registry,
+                channels=self.channels,
+                groups=self.groups,
                 metrics=self.metrics,
             ),
         )
@@ -134,7 +141,29 @@ class NakamaServer:
             self.metrics,
             matchmaker=self.matchmaker,
         )
-        self._ws_server = None
+        self.social = None  # social.Client attached when configured
+
+        from .leaderboard import (
+            LeaderboardRankCache,
+            LeaderboardScheduler,
+            Leaderboards,
+            Tournaments,
+        )
+
+        self.leaderboards = Leaderboards(
+            log,
+            self.db,
+            LeaderboardRankCache(config.leaderboard.blacklist_rank_cache),
+        )
+        self.tournaments = Tournaments(self.leaderboards)
+        self.leaderboard_scheduler = LeaderboardScheduler(
+            log, self.leaderboards, self.tournaments, runtime=None
+        )
+        self.leaderboards.on_change = self.leaderboard_scheduler.update
+
+        from .api.http import ApiServer
+
+        self.api = ApiServer(self)
 
     def attach_runtime(self, runtime):
         """Wire the extensibility runtime into the pipeline, the matchmaker
@@ -163,6 +192,7 @@ class NakamaServer:
         if fire_start is not None:
             self.acceptor.on_session_start = fire_start
             self.acceptor.on_session_end = runtime.fire_session_end
+        self.leaderboard_scheduler.runtime = runtime
 
     # ------------------------------------------------------------ lifecycle
 
@@ -190,19 +220,25 @@ class NakamaServer:
                 match_registry=self.match_registry,
                 party_registry=self.party_registry,
                 metrics=self.metrics,
+                leaderboards=self.leaderboards,
+                tournaments=self.tournaments,
+                channels=self.channels,
+                friends=self.friends,
+                groups=self.groups,
             )
             self.attach_runtime(runtime)
         if self.runtime is not None:
             self.runtime.start_events()
+        await self.leaderboards.load()
+        self.leaderboard_scheduler.start()
         self.tracker.start()
         self.matchmaker.start()
-        self._ws_server = await websockets.serve(
-            self.acceptor.handle,
+        # One port serves the REST API and /ws (reference api.go: the
+        # gateway HTTP listener owns both on the main port).
+        self.port = await self.api.start(
             self.config.socket.address or "127.0.0.1",
             self.config.socket.port if port is None else port,
-            max_size=self.config.socket.max_message_size_bytes,
         )
-        self.port = self._ws_server.sockets[0].getsockname()[1]
         self.logger.info("server listening", port=self.port)
 
     async def stop(self, grace_seconds: int | None = None):
@@ -212,10 +248,9 @@ class NakamaServer:
             if grace_seconds is None
             else grace_seconds
         )
-        if self._ws_server is not None:
-            self._ws_server.close()
-            await self._ws_server.wait_closed()
+        await self.api.stop()
         await self.match_registry.stop_all(grace)
+        self.leaderboard_scheduler.stop()
         self.matchmaker.stop()
         for session in self.session_registry.all():
             await session.close("server shutting down")

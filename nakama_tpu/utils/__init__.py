@@ -1,0 +1,5 @@
+"""Internal utility libraries (reference internal/: cronexpr, skiplist)."""
+
+from . import cronexpr
+
+__all__ = ["cronexpr"]

@@ -1,0 +1,288 @@
+"""Chat/channel tests — the VERDICT round-1 done-criterion: two WS clients
+join a room, exchange persisted messages, fetch history (reference
+core_channel.go:293,506; pipeline_channel.go), plus id mapping, DM/group
+streams, update/remove permissions, and history cursors."""
+
+import asyncio
+import json
+import time
+
+import pytest
+import websockets
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.core.channel import (
+    CHANNEL_TYPE_DM,
+    CHANNEL_TYPE_GROUP,
+    CHANNEL_TYPE_ROOM,
+    ChannelError,
+    Channels,
+    channel_id_to_stream,
+    channel_to_stream,
+    stream_to_channel_id,
+)
+from nakama_tpu.realtime import StreamMode
+from nakama_tpu.server import NakamaServer
+from nakama_tpu.storage.db import Database
+
+
+# ------------------------------------------------------------- id mapping
+
+
+def test_channel_id_roundtrip():
+    room = channel_to_stream(CHANNEL_TYPE_ROOM, "global")
+    assert room.mode == StreamMode.CHANNEL and room.label == "global"
+    cid = stream_to_channel_id(room)
+    assert cid == "2...global"  # mode.subject.subcontext.label
+    assert channel_id_to_stream(cid) == room
+
+    group = channel_to_stream(CHANNEL_TYPE_GROUP, "g-123")
+    assert group.mode == StreamMode.GROUP and group.subject == "g-123"
+    assert channel_id_to_stream(stream_to_channel_id(group)) == group
+
+    dm = channel_to_stream(CHANNEL_TYPE_DM, "user-b", "user-a")
+    assert dm.mode == StreamMode.DM
+    assert (dm.subject, dm.subcontext) == ("user-a", "user-b")
+    # Either direction produces the same channel.
+    dm2 = channel_to_stream(CHANNEL_TYPE_DM, "user-a", "user-b")
+    assert stream_to_channel_id(dm) == stream_to_channel_id(dm2)
+
+    for bad in ("", "1.x", "9.a.b.c", "2.subj..label", "4.a..x"):
+        with pytest.raises(ChannelError):
+            channel_id_to_stream(bad)
+    with pytest.raises(ChannelError):
+        channel_to_stream(CHANNEL_TYPE_DM, "me", "me")
+    with pytest.raises(ChannelError):
+        channel_to_stream(CHANNEL_TYPE_ROOM, "has.dot")
+
+
+# ----------------------------------------------------------- core + store
+
+
+async def make_channels():
+    db = Database(":memory:")
+    await db.connect()
+    return db, Channels(quiet_logger(), db)
+
+
+async def test_message_persist_update_remove_and_history():
+    db, ch = await make_channels()
+    try:
+        cid = ch.channel_id_build("", "lobby", CHANNEL_TYPE_ROOM)
+        sent = []
+        for i in range(7):
+            m = await ch.message_send(
+                cid, {"n": i}, sender_id="u1", sender_username="alice"
+            )
+            sent.append(m)
+
+        page = await ch.messages_list(cid, limit=3)
+        assert [json.loads(m["content"])["n"] for m in page["messages"]] == [
+            0, 1, 2
+        ]
+        page2 = await ch.messages_list(
+            cid, limit=3, cursor=page["next_cursor"]
+        )
+        assert [json.loads(m["content"])["n"] for m in page2["messages"]] == [
+            3, 4, 5
+        ]
+        back = await ch.messages_list(cid, limit=3, forward=False)
+        assert [json.loads(m["content"])["n"] for m in back["messages"]] == [
+            6, 5, 4
+        ]
+
+        # Update: only the sender.
+        mid = sent[0]["message_id"]
+        with pytest.raises(ChannelError):
+            await ch.message_update(cid, mid, {"x": 1}, sender_id="u2")
+        await ch.message_update(cid, mid, {"n": 100}, sender_id="u1")
+        page = await ch.messages_list(cid, limit=1)
+        assert json.loads(page["messages"][0]["content"]) == {"n": 100}
+
+        with pytest.raises(ChannelError):
+            await ch.message_remove(cid, mid, sender_id="u2")
+        await ch.message_remove(cid, mid, sender_id="u1")
+        page = await ch.messages_list(cid, limit=10)
+        assert len(page["messages"]) == 6
+
+        # Other channels don't leak into history.
+        other = ch.channel_id_build("", "other", CHANNEL_TYPE_ROOM)
+        assert (await ch.messages_list(other))["messages"] == []
+    finally:
+        await db.close()
+
+
+# --------------------------------------------------------------- over WS
+
+
+class Client:
+    def __init__(self, ws):
+        self.ws = ws
+        self.inbox: list[dict] = []
+
+    @classmethod
+    async def connect(cls, server, user_id, username):
+        token = server.issue_session(user_id, username)
+        ws = await websockets.connect(
+            f"ws://127.0.0.1:{server.port}/ws?token={token}"
+        )
+        return cls(ws)
+
+    async def send(self, envelope):
+        await self.ws.send(json.dumps(envelope))
+
+    async def recv(self, key, timeout=5.0):
+        for i, e in enumerate(self.inbox):
+            if key in e:
+                return self.inbox.pop(i)
+        deadline = time.monotonic() + timeout
+        while True:
+            raw = await asyncio.wait_for(
+                self.ws.recv(), timeout=max(0.01, deadline - time.monotonic())
+            )
+            e = json.loads(raw)
+            if key in e:
+                return e
+            self.inbox.append(e)
+
+    async def close(self):
+        await self.ws.close()
+
+
+async def test_room_chat_end_to_end():
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    try:
+        alice = await Client.connect(server, "ua", "alice")
+        bob = await Client.connect(server, "ub", "bob")
+
+        await alice.send(
+            {"cid": "1", "channel_join": {"type": 1, "target": "tavern"}}
+        )
+        chan = (await alice.recv("channel"))["channel"]
+        assert chan["room_name"] == "tavern"
+        channel_id = chan["id"]
+
+        await bob.send(
+            {"cid": "1", "channel_join": {"type": 1, "target": "tavern"}}
+        )
+        bchan = (await bob.recv("channel"))["channel"]
+        assert {p["user_id"] for p in bchan["presences"]} == {"ua"}
+
+        # Bob cannot send without joining — covered: he joined; eve didn't.
+        eve = await Client.connect(server, "ue", "eve")
+        await eve.send(
+            {
+                "cid": "x",
+                "channel_message_send": {
+                    "channel_id": channel_id,
+                    "content": {"text": "sneak"},
+                },
+            }
+        )
+        err = await eve.recv("error")
+        assert "join" in err["error"]["message"]
+
+        await alice.send(
+            {
+                "cid": "2",
+                "channel_message_send": {
+                    "channel_id": channel_id,
+                    "content": {"text": "hello bob"},
+                },
+            }
+        )
+        ack = (await alice.recv("channel_message_ack"))["channel_message_ack"]
+        assert ack["channel_id"] == channel_id
+
+        msg = (await bob.recv("channel_message"))["channel_message"]
+        assert json.loads(msg["content"]) == {"text": "hello bob"}
+        assert msg["sender_id"] == "ua"
+        assert msg["username"] == "alice"
+        # The sender sees their own message on the stream too (reference
+        # routes to the whole channel stream).
+        own = (await alice.recv("channel_message"))["channel_message"]
+        assert json.loads(own["content"]) == {"text": "hello bob"}
+
+        await bob.send(
+            {
+                "cid": "3",
+                "channel_message_send": {
+                    "channel_id": channel_id,
+                    "content": {"text": "hi alice"},
+                },
+            }
+        )
+        msg = (await alice.recv("channel_message"))["channel_message"]
+        assert json.loads(msg["content"]) == {"text": "hi alice"}
+        own = (await bob.recv("channel_message"))["channel_message"]
+        assert json.loads(own["content"]) == {"text": "hi alice"}
+
+        # Persisted history is fetchable (core-level check through the
+        # server's channels component).
+        history = await server.channels.messages_list(channel_id)
+        texts = [json.loads(m["content"])["text"] for m in history["messages"]]
+        assert texts == ["hello bob", "hi alice"]
+
+        # Leave: no more fan-out to bob.
+        await bob.send(
+            {"cid": "4", "channel_leave": {"channel_id": channel_id}}
+        )
+        await asyncio.sleep(0.1)
+        await alice.send(
+            {
+                "cid": "5",
+                "channel_message_send": {
+                    "channel_id": channel_id,
+                    "content": {"text": "gone?"},
+                },
+            }
+        )
+        await alice.recv("channel_message_ack")
+        with pytest.raises(asyncio.TimeoutError):
+            await bob.recv("channel_message", timeout=0.4)
+
+        await alice.close()
+        await bob.close()
+        await eve.close()
+    finally:
+        await server.stop(0)
+
+
+async def test_dm_channel_over_ws():
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    try:
+        alice = await Client.connect(server, "ua", "alice")
+        bob = await Client.connect(server, "ub", "bob")
+        await alice.send(
+            {"cid": "1", "channel_join": {"type": 3, "target": "ub"}}
+        )
+        chan = (await alice.recv("channel"))["channel"]
+        await bob.send(
+            {"cid": "1", "channel_join": {"type": 3, "target": "ua"}}
+        )
+        bchan = (await bob.recv("channel"))["channel"]
+        assert chan["id"] == bchan["id"]  # both ends land in one channel
+
+        await alice.send(
+            {
+                "cid": "2",
+                "channel_message_send": {
+                    "channel_id": chan["id"],
+                    "content": {"text": "psst"},
+                },
+            }
+        )
+        msg = (await bob.recv("channel_message"))["channel_message"]
+        assert json.loads(msg["content"]) == {"text": "psst"}
+        await alice.close()
+        await bob.close()
+    finally:
+        await server.stop(0)

@@ -1,0 +1,180 @@
+"""Friend-graph and group state-machine tests mirroring reference
+semantics (reference server/core_friend.go, core_group.go)."""
+
+import pytest
+
+from fixtures import quiet_logger
+
+from nakama_tpu.core.friend import (
+    BLOCKED,
+    FRIEND,
+    INVITE_RECEIVED,
+    INVITE_SENT,
+    FriendError,
+    Friends,
+)
+from nakama_tpu.core.group import (
+    ADMIN,
+    JOIN_REQUEST,
+    MEMBER,
+    SUPERADMIN,
+    GroupError,
+    Groups,
+)
+from nakama_tpu.storage.db import Database
+
+
+async def make_db(users=("ua", "ub", "uc", "ud")):
+    db = Database(":memory:")
+    await db.connect()
+    for uid in users:
+        await db.execute(
+            "INSERT INTO users (id, username, create_time, update_time)"
+            " VALUES (?, ?, 0, 0)",
+            (uid, f"name-{uid}"),
+        )
+    return db
+
+
+# -------------------------------------------------------------- friends
+
+
+async def test_friend_invite_accept_flow():
+    db = await make_db()
+    f = Friends(quiet_logger(), db)
+    try:
+        await f.add("ua", "alice", "ub")
+        assert await f.state_of("ua", "ub") == INVITE_SENT
+        assert await f.state_of("ub", "ua") == INVITE_RECEIVED
+
+        # Invites show up filtered by state.
+        received = await f.list("ub", state=INVITE_RECEIVED)
+        assert [x["user"]["id"] for x in received["friends"]] == ["ua"]
+
+        # Accepting = the invited side adds back.
+        await f.add("ub", "bob", "ua")
+        assert await f.state_of("ua", "ub") == FRIEND
+        assert await f.state_of("ub", "ua") == FRIEND
+
+        # Idempotent re-add.
+        await f.add("ua", "alice", "ub")
+        assert await f.state_of("ua", "ub") == FRIEND
+
+        listing = await f.list("ua")
+        assert [x["state"] for x in listing["friends"]] == [FRIEND]
+
+        # Delete removes both edges.
+        await f.delete("ua", "ub")
+        assert await f.state_of("ua", "ub") is None
+        assert await f.state_of("ub", "ua") is None
+    finally:
+        await db.close()
+
+
+async def test_friend_blocking():
+    db = await make_db()
+    f = Friends(quiet_logger(), db)
+    try:
+        await f.add("ua", "alice", "ub")
+        await f.block("ub", "bob", "ua")
+        # Block removed bob's received-invite edge and alice's edge stays
+        # only on... the reference removes the reverse (alice's) edge:
+        assert await f.state_of("ub", "ua") == BLOCKED
+        assert await f.state_of("ua", "ub") is None
+
+        # Blocked: alice's re-add is silently ignored.
+        await f.add("ua", "alice", "ub")
+        assert await f.state_of("ua", "ub") is None
+
+        # delete() does not unblock.
+        await f.delete("ub", "ua")
+        assert await f.state_of("ub", "ua") == BLOCKED
+        await f.unblock("ub", "ua")
+        assert await f.state_of("ub", "ua") is None
+
+        with pytest.raises(FriendError):
+            await f.add("ua", "alice", "ua")
+        with pytest.raises(FriendError):
+            await f.add("ua", "alice", "missing")
+    finally:
+        await db.close()
+
+
+# --------------------------------------------------------------- groups
+
+
+async def test_group_open_join_and_roles():
+    db = await make_db()
+    g = Groups(quiet_logger(), db)
+    try:
+        group = await g.create("ua", "Raiders", open=True, max_count=3)
+        gid = group["id"]
+        assert group["edge_count"] == 1 and group["open"] is True
+
+        with pytest.raises(GroupError):
+            await g.create("ub", "Raiders")  # name taken
+
+        await g.join(gid, "ub")
+        await g.join(gid, "uc")
+        group = await g.get(gid)
+        assert group["edge_count"] == 3
+        with pytest.raises(GroupError):
+            await g.join(gid, "ud")  # full
+
+        # Promote ub: member -> admin; demote back.
+        await g.users_promote(gid, ["ub"], caller_id="ua")
+        users = await g.users_list(gid)
+        state_of = {
+            u["user"]["id"]: u["state"] for u in users["group_users"]
+        }
+        assert state_of == {"ua": SUPERADMIN, "ub": ADMIN, "uc": MEMBER}
+
+        # Non-admin cannot kick.
+        with pytest.raises(GroupError):
+            await g.users_kick(gid, ["ub"], caller_id="uc")
+        await g.users_kick(gid, ["uc"], caller_id="ub")
+        assert (await g.get(gid))["edge_count"] == 2
+
+        # Last superadmin cannot leave.
+        with pytest.raises(GroupError):
+            await g.leave(gid, "ua")
+        await g.users_promote(gid, ["ub"], caller_id="ua")  # admin->super
+        await g.leave(gid, "ua")
+        assert (await g.get(gid))["edge_count"] == 1
+    finally:
+        await db.close()
+
+
+async def test_group_closed_join_request_flow():
+    db = await make_db()
+    g = Groups(quiet_logger(), db)
+    try:
+        gid = (await g.create("ua", "Secret", open=False))["id"]
+        await g.join(gid, "ub")
+        users = await g.users_list(gid, state=JOIN_REQUEST)
+        assert [u["user"]["id"] for u in users["group_users"]] == ["ub"]
+        assert (await g.get(gid))["edge_count"] == 1  # not a member yet
+
+        # Accept via users_add.
+        await g.users_add(gid, ["ub"], caller_id="ua")
+        assert (await g.get(gid))["edge_count"] == 2
+
+        # Ban then rejoin refused.
+        await g.users_ban(gid, ["ub"], caller_id="ua")
+        assert (await g.get(gid))["edge_count"] == 1
+        with pytest.raises(GroupError):
+            await g.join(gid, "ub")
+
+        # user_groups_list from the user side.
+        mine = await g.user_groups_list("ua")
+        assert [x["group"]["id"] for x in mine["user_groups"]] == [gid]
+
+        # Search listing.
+        found = await g.list(name="Sec*")
+        assert [x["id"] for x in found["groups"]] == [gid]
+
+        await g.delete(gid, caller_id="ua")
+        with pytest.raises(GroupError):
+            await g.get(gid)
+    finally:
+        await db.close()
