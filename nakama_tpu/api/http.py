@@ -188,13 +188,15 @@ class ApiServer:
                 self._make_group_action(action),
             )
 
-        # Surfaces whose cores land with their subsystems; the route shape
-        # is reserved now so clients get a structured UNIMPLEMENTED, not 404
-        # (reference: apigrpc.proto full rpc list).
-        for method, path in (
-            ("GET", "/v2/notification"),
-        ):
-            r.add_route(method, path, self._h_unimplemented)
+        r.add_get("/v2/notification", self._h_notification_list)
+        r.add_delete("/v2/notification", self._h_notification_delete)
+
+        for store in ("apple", "google", "huawei"):
+            r.add_post(
+                f"/v2/iap/purchase/{store}",
+                self._make_iap_validate(store),
+            )
+        r.add_get("/v2/iap/subscription", self._h_subscription_list)
 
     # ----------------------------------------------------------- lifecycle
 
@@ -803,6 +805,74 @@ class ApiServer:
         except Exception as e:
             return self._map_error(e)
 
+    # ---------------------------------------------------------------- iap
+
+    def _make_iap_validate(self, store: str):
+        async def handler(request: web.Request):
+            from ..iap import IAPError
+
+            try:
+                claims = self._session(request)
+                body = await self._json(request)
+                receipt = body.get("receipt", body.get("purchase", ""))
+                if not receipt:
+                    raise ApiError(
+                        "receipt required", 400, GRPC_INVALID_ARGUMENT
+                    )
+                fn = getattr(self.server.purchases, f"validate_{store}")
+                try:
+                    validated = await fn(
+                        claims.user_id,
+                        receipt,
+                        persist=_parse_bool(body.get("persist", True)),
+                    )
+                except IAPError as e:
+                    raise ApiError(str(e), 400, GRPC_INVALID_ARGUMENT)
+                return web.json_response(
+                    {"validated_purchases": validated}
+                )
+            except Exception as e:
+                return self._map_error(e)
+
+        return handler
+
+    async def _h_subscription_list(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            q = request.query
+            result = await self.server.purchases.list_subscriptions(
+                claims.user_id,
+                limit=int(q.get("limit", 100)),
+                cursor=q.get("cursor", ""),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    # ------------------------------------------------------ notifications
+
+    async def _h_notification_list(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            q = request.query
+            result = await self.server.notifications.list(
+                claims.user_id,
+                limit=int(q.get("limit", 100)),
+                cursor=q.get("cacheable_cursor", q.get("cursor", "")),
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_notification_delete(self, request: web.Request):
+        try:
+            claims = self._session(request)
+            ids = request.query.getall("ids", [])
+            await self.server.notifications.delete(claims.user_id, ids)
+            return web.json_response({})
+        except Exception as e:
+            return self._map_error(e)
+
     # ----------------------------------------------------------- friends
 
     async def _resolve_target_ids(self, request: web.Request) -> list[str]:
@@ -1148,6 +1218,8 @@ class ApiServer:
         from ..core.channel import ChannelError
         from ..core.friend import FriendError
         from ..core.group import GroupError
+        from ..core.notification import NotificationError
+        from ..core.wallet import WalletError
         from ..leaderboard import LeaderboardError
 
         if isinstance(e, ApiError):
@@ -1155,7 +1227,7 @@ class ApiServer:
         if isinstance(
             e,
             (AuthError, ChannelError, FriendError, GroupError,
-             LeaderboardError),
+             LeaderboardError, NotificationError, WalletError),
         ):
             status, code = _AUTH_CODE_TO_HTTP.get(
                 getattr(e, "code", ""), (400, GRPC_INVALID_ARGUMENT)

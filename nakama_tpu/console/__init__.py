@@ -1,0 +1,5 @@
+"""Admin console server (L5) — reference server/console.go:167."""
+
+from .server import ConsoleServer
+
+__all__ = ["ConsoleServer"]

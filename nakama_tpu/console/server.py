@@ -1,0 +1,421 @@
+"""Admin console: a second HTTP listener with its own auth.
+
+Parity: reference server/console.go:167 StartConsoleServer — separate
+port, own JWT signing key, authentication against the configured root
+admin (config console.username/password) or `console_user` rows with
+role-based access and login-attempt lockout (console_authenticate.go:73),
+and the operator surface of the console_*.go handlers: account browse/
+edit/ban, storage browse/edit, match listing + live state view
+(match_registry GetState, console uses it), leaderboard browse, purchase
+browse, redacted config view, runtime info (loaded modules + rpc ids),
+and a status snapshot fed by the metrics registry (status_handler.go:64).
+The reference embeds an Angular UI; the JSON API is the contract here.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from aiohttp import web
+
+from ..api import session_token
+from ..core import authenticate as core_auth
+
+ROLE_ADMIN = 1
+ROLE_DEVELOPER = 2
+ROLE_MAINTAINER = 3
+ROLE_READONLY = 4
+
+_REDACTED_KEYS = (
+    "password", "key", "secret", "private", "token",
+)
+
+
+class ConsoleServer:
+    def __init__(self, server):
+        self.server = server
+        self.config = server.config
+        self.logger = server.logger.with_fields(subsystem="console")
+        self.app = web.Application(
+            client_max_size=self.config.console.max_message_size_bytes
+        )
+        self._runner = None
+        self._site = None
+        self.port: int | None = None
+        self._started_at = time.time()
+
+        r = self.app.router
+        r.add_post("/v2/console/authenticate", self._h_authenticate)
+        r.add_get("/v2/console/status", self._h_status)
+        r.add_get("/v2/console/config", self._h_config)
+        r.add_get("/v2/console/runtime", self._h_runtime)
+        r.add_get("/v2/console/account", self._h_account_list)
+        r.add_get("/v2/console/account/{id}", self._h_account_get)
+        r.add_post("/v2/console/account/{id}/ban", self._h_account_ban)
+        r.add_post("/v2/console/account/{id}/unban", self._h_account_unban)
+        r.add_delete("/v2/console/account/{id}", self._h_account_delete)
+        r.add_get("/v2/console/storage", self._h_storage_list)
+        r.add_get(
+            "/v2/console/storage/{collection}/{key}/{user_id}",
+            self._h_storage_get,
+        )
+        r.add_get("/v2/console/match", self._h_match_list)
+        r.add_get("/v2/console/matchmaker", self._h_matchmaker)
+        r.add_get("/v2/console/match/{id}/state", self._h_match_state)
+        r.add_get("/v2/console/leaderboard", self._h_leaderboard_list)
+        r.add_get(
+            "/v2/console/leaderboard/{id}", self._h_leaderboard_records
+        )
+        r.add_get("/v2/console/purchase", self._h_purchase_list)
+        r.add_post("/v2/console/api/endpoints/rpc/{id}", self._h_call_rpc)
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self, host: str, port: int) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, host, port)
+        await self._site.start()
+        self.port = self._site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ---------------------------------------------------------------- auth
+
+    async def _h_authenticate(self, request: web.Request):
+        """Root admin from config, else console_user rows; failures feed
+        the login-attempt lockout (reference console_authenticate.go:73)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        username = body.get("username", "")
+        password = body.get("password", "")
+        attempts = self.server.login_attempt_cache
+        client_ip = request.remote or ""
+        if not attempts.allow(f"console:{username}", client_ip):
+            return _err(429, "too many attempts, locked out")
+        role = None
+        if (
+            username == self.config.console.username
+            and password == self.config.console.password
+        ):
+            role = ROLE_ADMIN
+        else:
+            row = await self.server.db.fetch_one(
+                "SELECT id, password, role, disable_time FROM console_user"
+                " WHERE username = ?",
+                (username,),
+            )
+            if (
+                row is not None
+                and not row["disable_time"]
+                and core_auth.check_password(row["password"], password)
+            ):
+                role = row["role"]
+        if role is None:
+            attempts.add_failure(f"console:{username}", client_ip)
+            return _err(401, "invalid credentials")
+        attempts.reset(f"console:{username}")
+        token, _ = session_token.generate(
+            self.config.console.signing_key,
+            username,
+            username,
+            self.config.console.token_expiry_sec,
+            vars={"role": str(role)},
+        )
+        return web.json_response({"token": token, "role": role})
+
+    def _auth(self, request: web.Request, write: bool = False) -> int:
+        header = request.headers.get("Authorization", "")
+        token = header[7:] if header.startswith("Bearer ") else ""
+        try:
+            claims = session_token.parse(
+                self.config.console.signing_key, token
+            )
+        except session_token.TokenError:
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"error": "console auth required"}),
+                content_type="application/json",
+            )
+        role = int(claims.vars.get("role", ROLE_READONLY))
+        if write and role > ROLE_MAINTAINER:
+            raise web.HTTPForbidden(
+                text=json.dumps({"error": "read-only console user"}),
+                content_type="application/json",
+            )
+        return role
+
+    # -------------------------------------------------------------- status
+
+    async def _h_status(self, request: web.Request):
+        self._auth(request)
+        s = self.server
+        return web.json_response(
+            {
+                "name": self.config.name,
+                "uptime_sec": time.time() - self._started_at,
+                "sessions": len(s.session_registry.all()),
+                "presences": s.tracker.count(),
+                "matches": len(s.match_registry),
+                "matchmaker_tickets": len(s.matchmaker),
+                "config_warnings": self.config.check(),
+            }
+        )
+
+    async def _h_config(self, request: web.Request):
+        """Config tree with secret redaction (reference
+        console_config.go)."""
+        self._auth(request)
+        import dataclasses
+
+        def scrub(obj):
+            if dataclasses.is_dataclass(obj):
+                out = {}
+                for f in dataclasses.fields(obj):
+                    value = getattr(obj, f.name)
+                    if any(k in f.name.lower() for k in _REDACTED_KEYS) and (
+                        isinstance(value, str) and value
+                    ):
+                        out[f.name] = "<redacted>"
+                    else:
+                        out[f.name] = scrub(value)
+                return out
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in obj.items()}
+            if isinstance(obj, (list, tuple)):
+                return [scrub(v) for v in obj]
+            return obj
+
+        return web.json_response(scrub(self.config))
+
+    async def _h_runtime(self, request: web.Request):
+        self._auth(request)
+        runtime = self.server.runtime
+        return web.json_response(
+            {
+                "loaded": runtime is not None,
+                "modules": list(runtime.modules) if runtime else [],
+                "rpcs": runtime.rpc_ids() if runtime else [],
+                "matches": runtime.match_names() if runtime else [],
+            }
+        )
+
+    # ------------------------------------------------------------ accounts
+
+    async def _h_account_list(self, request: web.Request):
+        self._auth(request)
+        q = request.query
+        limit = max(1, min(int(q.get("limit", 50)), 100))
+        filter_ = q.get("filter", "")
+        params: list = []
+        where = "WHERE 1=1"
+        if filter_:
+            where += " AND (id = ? OR username LIKE ?)"
+            params.extend([filter_, f"{filter_}%"])
+        rows = await self.server.db.fetch_all(
+            f"SELECT id, username, display_name, create_time, disable_time"
+            f" FROM users {where} ORDER BY create_time DESC LIMIT ?",
+            (*params, limit),
+        )
+        return web.json_response(
+            {
+                "users": [dict(r) for r in rows],
+                "total_count": (
+                    await self.server.db.fetch_one(
+                        "SELECT COUNT(*) AS n FROM users"
+                    )
+                )["n"],
+            }
+        )
+
+    async def _h_account_get(self, request: web.Request):
+        self._auth(request)
+        from ..core import account as core_account
+
+        try:
+            account = await core_account.get_account(
+                self.server.db, request.match_info["id"]
+            )
+        except core_auth.AuthError:
+            return _err(404, "account not found")
+        wallet = await self.server.wallets.get(request.match_info["id"])
+        account["wallet"] = wallet
+        return web.json_response(account)
+
+    async def _h_account_ban(self, request: web.Request):
+        self._auth(request, write=True)
+        user_id = request.match_info["id"]
+        await self.server.db.execute(
+            "UPDATE users SET disable_time = ? WHERE id = ?",
+            (time.time(), user_id),
+        )
+        self.server.session_cache.ban([user_id])
+        return web.json_response({})
+
+    async def _h_account_unban(self, request: web.Request):
+        self._auth(request, write=True)
+        user_id = request.match_info["id"]
+        await self.server.db.execute(
+            "UPDATE users SET disable_time = 0 WHERE id = ?", (user_id,)
+        )
+        self.server.session_cache.unban([user_id])
+        return web.json_response({})
+
+    async def _h_account_delete(self, request: web.Request):
+        self._auth(request, write=True)
+        from ..core import account as core_account
+
+        await core_account.delete_account(
+            self.server.db, request.match_info["id"], recorded=True
+        )
+        return web.json_response({})
+
+    # ------------------------------------------------------------- storage
+
+    async def _h_storage_list(self, request: web.Request):
+        self._auth(request)
+        q = request.query
+        limit = max(1, min(int(q.get("limit", 50)), 100))
+        params: list = []
+        where = "WHERE 1=1"
+        if q.get("collection"):
+            where += " AND collection = ?"
+            params.append(q["collection"])
+        if q.get("user_id"):
+            where += " AND user_id = ?"
+            params.append(q["user_id"])
+        rows = await self.server.db.fetch_all(
+            f"SELECT collection, key, user_id, version, update_time"
+            f" FROM storage {where} ORDER BY collection, key LIMIT ?",
+            (*params, limit),
+        )
+        return web.json_response({"objects": [dict(r) for r in rows]})
+
+    async def _h_storage_get(self, request: web.Request):
+        self._auth(request)
+        row = await self.server.db.fetch_one(
+            "SELECT * FROM storage WHERE collection = ? AND key = ?"
+            " AND user_id = ?",
+            (
+                request.match_info["collection"],
+                request.match_info["key"],
+                request.match_info["user_id"],
+            ),
+        )
+        if row is None:
+            return _err(404, "object not found")
+        return web.json_response(dict(row))
+
+    # ------------------------------------------------------------- matches
+
+    async def _h_match_list(self, request: web.Request):
+        self._auth(request)
+        matches = self.server.match_registry.list_matches(
+            int(request.query.get("limit", 100))
+        )
+        return web.json_response({"matches": matches})
+
+    async def _h_matchmaker(self, request: web.Request):
+        """Matchmaker observability: pool gauges + the per-interval device
+        timing breadcrumbs (SURVEY §5)."""
+        self._auth(request)
+        mm = self.server.matchmaker
+        tracing = getattr(mm.backend, "tracing", None)
+        return web.json_response(
+            {
+                "tickets": len(mm),
+                "active": len(mm.active),
+                "backend": type(mm.backend).__name__,
+                "intervals": (
+                    tracing.recent(int(request.query.get("n", 32)))
+                    if tracing is not None
+                    else []
+                ),
+            }
+        )
+
+    async def _h_match_state(self, request: web.Request):
+        """Live authoritative match state (reference console match view via
+        MatchRegistry GetState, match_registry.go:123)."""
+        self._auth(request)
+        state = self.server.match_registry.get_state(
+            request.match_info["id"]
+        )
+        if state is None:
+            return _err(404, "match not found")
+        state_json, tick, presence_count = state
+        return web.json_response(
+            {
+                "state": state_json,
+                "tick": tick,
+                "presences": presence_count,
+            }
+        )
+
+    # -------------------------------------------- leaderboards / purchases
+
+    async def _h_leaderboard_list(self, request: web.Request):
+        self._auth(request)
+        return web.json_response(
+            {
+                "leaderboards": [
+                    lb.as_dict()
+                    for lb in self.server.leaderboards.list(
+                        with_tournaments=True
+                    )
+                ]
+            }
+        )
+
+    async def _h_leaderboard_records(self, request: web.Request):
+        self._auth(request)
+        try:
+            result = await self.server.leaderboards.records_list(
+                request.match_info["id"],
+                limit=int(request.query.get("limit", 100)),
+            )
+        except Exception as e:
+            return _err(404, str(e))
+        return web.json_response(result)
+
+    async def _h_purchase_list(self, request: web.Request):
+        self._auth(request)
+        return web.json_response(
+            await self.server.purchases.list(
+                user_id=request.query.get("user_id") or None,
+                limit=int(request.query.get("limit", 100)),
+            )
+        )
+
+    # --------------------------------------------------------------- rpc
+
+    async def _h_call_rpc(self, request: web.Request):
+        """API explorer: invoke any registered RPC as the console
+        (reference console_api_explorer.go)."""
+        self._auth(request, write=True)
+        runtime = self.server.runtime
+        if runtime is None:
+            return _err(501, "runtime not loaded")
+        fn = runtime.rpc(request.match_info["id"].lower())
+        if fn is None:
+            return _err(404, "rpc not found")
+        payload = await request.text()
+        import asyncio
+
+        try:
+            result = fn(runtime.context(mode="console"), payload)
+            if asyncio.iscoroutine(result):
+                result = await result
+        except Exception as e:
+            return _err(500, str(e))
+        return web.json_response({"payload": result or ""})
+
+
+def _err(status: int, message: str):
+    return web.json_response({"error": message}, status=status)

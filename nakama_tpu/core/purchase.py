@@ -1,0 +1,236 @@
+"""Purchase + subscription persistence over the IAP validators.
+
+Parity: reference server/core_purchase.go (validate→upsert keyed by
+transaction id, seen-before detection, user association, cursored
+listing) and core_subscription.go (subscription lifecycle rows keyed by
+original transaction id with expiry tracking).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..iap import IAPError, ValidatedPurchase
+
+
+class Purchases:
+    def __init__(self, logger, db, config, fetch=None):
+        self.logger = logger.with_fields(subsystem="purchase")
+        self.db = db
+        self.config = config
+        self._fetch = fetch  # injectable for tests; None = real HTTPS
+
+    # --------------------------------------------------------- validation
+
+    async def validate_apple(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> list[dict]:
+        from ..iap import validate_receipt_apple
+
+        validated = await validate_receipt_apple(
+            self.config.iap.apple_shared_password, receipt, self._fetch
+        )
+        return await self._store(user_id, validated, persist)
+
+    async def validate_google(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> list[dict]:
+        from ..iap import validate_receipt_google
+
+        validated = await validate_receipt_google(
+            self.config.iap.google_client_email,
+            self.config.iap.google_private_key,
+            receipt,
+            self._fetch,
+        )
+        return await self._store(user_id, validated, persist)
+
+    async def validate_huawei(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> list[dict]:
+        from ..iap import validate_receipt_huawei
+
+        validated = await validate_receipt_huawei(
+            self.config.iap.huawei_client_id,
+            self.config.iap.huawei_client_secret,
+            receipt,
+            self._fetch,
+        )
+        return await self._store(user_id, validated, persist)
+
+    async def _store(
+        self,
+        user_id: str,
+        validated: list[ValidatedPurchase],
+        persist: bool,
+    ) -> list[dict]:
+        now = time.time()
+        seen: dict[str, bool] = {}
+        if persist:
+            # One transaction for the whole receipt: a multi-item receipt
+            # persists atomically, so a retried validation can't misreport
+            # partially-committed items as seen_before (reference
+            # StorePurchases batches in one tx).
+            async with self.db.tx() as tx:
+                for v in validated:
+                    row = await tx.fetch_one(
+                        "SELECT user_id FROM purchase"
+                        " WHERE transaction_id = ?",
+                        (v.transaction_id,),
+                    )
+                    seen[v.transaction_id] = row is not None
+                    if row is None:
+                        await tx.execute(
+                            "INSERT INTO purchase (user_id, transaction_id,"
+                            " product_id, store, raw_response,"
+                            " purchase_time, create_time, update_time,"
+                            " environment)"
+                            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                            (
+                                user_id, v.transaction_id, v.product_id,
+                                v.store, json.dumps(v.raw_response),
+                                v.purchase_time, now, now, v.environment,
+                            ),
+                        )
+        return [
+            {
+                "user_id": user_id,
+                "transaction_id": v.transaction_id,
+                "product_id": v.product_id,
+                "store": v.store,
+                "purchase_time": v.purchase_time,
+                "environment": v.environment,
+                "seen_before": seen.get(v.transaction_id, False),
+            }
+            for v in validated
+        ]
+
+    # ------------------------------------------------------------ queries
+
+    async def list(
+        self, user_id: str | None = None, limit: int = 100, cursor: str = ""
+    ) -> dict:
+        limit = max(1, min(int(limit), 100))
+        offset = int(cursor) if cursor else 0
+        where, params = "", []
+        if user_id:
+            where = "WHERE user_id = ?"
+            params.append(user_id)
+        rows = await self.db.fetch_all(
+            f"SELECT * FROM purchase {where}"
+            " ORDER BY purchase_time DESC, transaction_id"
+            " LIMIT ? OFFSET ?",
+            (*params, limit + 1, offset),
+        )
+        has_more = len(rows) > limit
+        rows = rows[:limit]
+        return {
+            "validated_purchases": [
+                {
+                    "user_id": r["user_id"],
+                    "transaction_id": r["transaction_id"],
+                    "product_id": r["product_id"],
+                    "store": r["store"],
+                    "purchase_time": r["purchase_time"],
+                    "refund_time": r["refund_time"],
+                    "environment": r["environment"],
+                }
+                for r in rows
+            ],
+            "cursor": str(offset + limit) if has_more else "",
+        }
+
+    async def get_by_transaction(self, transaction_id: str) -> dict | None:
+        r = await self.db.fetch_one(
+            "SELECT * FROM purchase WHERE transaction_id = ?",
+            (transaction_id,),
+        )
+        if r is None:
+            return None
+        return {
+            "user_id": r["user_id"],
+            "transaction_id": r["transaction_id"],
+            "product_id": r["product_id"],
+            "store": r["store"],
+            "purchase_time": r["purchase_time"],
+            "refund_time": r["refund_time"],
+            "environment": r["environment"],
+        }
+
+    # -------------------------------------------------------- subscriptions
+
+    async def upsert_subscription(
+        self,
+        user_id: str,
+        original_transaction_id: str,
+        product_id: str,
+        store: int,
+        expire_time: float,
+        environment: int = 0,
+        raw_response: dict | None = None,
+    ) -> dict:
+        now = time.time()
+        await self.db.execute(
+            "INSERT INTO subscription (user_id, original_transaction_id,"
+            " product_id, store, raw_response, purchase_time, create_time,"
+            " update_time, expire_time, environment)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
+            " ON CONFLICT (original_transaction_id) DO UPDATE SET"
+            " expire_time = ?, update_time = ?, raw_response = ?",
+            (
+                user_id, original_transaction_id, product_id, store,
+                json.dumps(raw_response or {}), now, now, now, expire_time,
+                environment,
+                expire_time, now, json.dumps(raw_response or {}),
+            ),
+        )
+        return await self.get_subscription(original_transaction_id)
+
+    async def get_subscription(
+        self, original_transaction_id: str
+    ) -> dict | None:
+        r = await self.db.fetch_one(
+            "SELECT * FROM subscription WHERE original_transaction_id = ?",
+            (original_transaction_id,),
+        )
+        if r is None:
+            return None
+        return {
+            "user_id": r["user_id"],
+            "original_transaction_id": r["original_transaction_id"],
+            "product_id": r["product_id"],
+            "store": r["store"],
+            "purchase_time": r["purchase_time"],
+            "expire_time": r["expire_time"],
+            "active": r["expire_time"] > time.time(),
+            "environment": r["environment"],
+        }
+
+    async def list_subscriptions(
+        self, user_id: str, limit: int = 100, cursor: str = ""
+    ) -> dict:
+        limit = max(1, min(int(limit), 100))
+        offset = int(cursor) if cursor else 0
+        rows = await self.db.fetch_all(
+            "SELECT * FROM subscription WHERE user_id = ?"
+            " ORDER BY purchase_time DESC LIMIT ? OFFSET ?",
+            (user_id, limit + 1, offset),
+        )
+        has_more = len(rows) > limit
+        rows = rows[:limit]
+        now = time.time()
+        return {
+            "subscriptions": [
+                {
+                    "original_transaction_id": r["original_transaction_id"],
+                    "product_id": r["product_id"],
+                    "store": r["store"],
+                    "purchase_time": r["purchase_time"],
+                    "expire_time": r["expire_time"],
+                    "active": r["expire_time"] > now,
+                }
+                for r in rows
+            ],
+            "cursor": str(offset + limit) if has_more else "",
+        }

@@ -106,75 +106,86 @@ async def storage_write_objects(
     core_storage.go:467). `caller_id=None` is the system/runtime caller and
     bypasses ownership + write-permission checks; a client caller may only
     write its own objects and only where permission_write allows."""
+    async with db.tx() as tx:
+        return await storage_write_objects_in_tx(tx, caller_id, ops)
+
+
+async def storage_write_objects_in_tx(
+    tx,
+    caller_id: str | None,
+    ops: list[StorageOpWrite],
+) -> list[StorageAck]:
+    """Write body on an already-open transaction — the composition seam
+    for MultiUpdate (reference core_multi.go runs storage writes inside
+    the shared tx)."""
     acks: list[StorageAck] = []
     now = time.time()
-    async with db.tx() as tx:
-        for op in ops:
-            if not op.collection or not op.key:
-                raise StorageError("collection and key are required")
-            _validate_value(op.value)
-            if op.permission_read not in (0, 1, 2) or op.permission_write not in (0, 1):
-                raise StorageError("invalid permission values")
-            if caller_id is not None and op.user_id and op.user_id != caller_id:
-                raise StoragePermissionError(
-                    "cannot write objects owned by another user"
-                )
-            if caller_id is not None and not op.user_id:
-                raise StoragePermissionError(
-                    "cannot write system-owned objects"
-                )
-            row = await tx.fetch_one(
-                "SELECT version, write FROM storage"
+    for op in ops:
+        if not op.collection or not op.key:
+            raise StorageError("collection and key are required")
+        _validate_value(op.value)
+        if op.permission_read not in (0, 1, 2) or op.permission_write not in (0, 1):
+            raise StorageError("invalid permission values")
+        if caller_id is not None and op.user_id and op.user_id != caller_id:
+            raise StoragePermissionError(
+                "cannot write objects owned by another user"
+            )
+        if caller_id is not None and not op.user_id:
+            raise StoragePermissionError(
+                "cannot write system-owned objects"
+            )
+        row = await tx.fetch_one(
+            "SELECT version, write FROM storage"
+            " WHERE collection = ? AND key = ? AND user_id = ?",
+            (op.collection, op.key, op.user_id),
+        )
+        new_version = _version_of(op.value)
+        if row is None:
+            # Insert path: fails OCC if a specific version was expected.
+            if op.version and op.version != "*":
+                raise StorageVersionError("version check failed")
+            await tx.execute(
+                "INSERT INTO storage (collection, key, user_id, value,"
+                " version, read, write, create_time, update_time)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (
+                    op.collection,
+                    op.key,
+                    op.user_id,
+                    op.value,
+                    new_version,
+                    op.permission_read,
+                    op.permission_write,
+                    now,
+                    now,
+                ),
+            )
+        else:
+            if caller_id is not None and row["write"] != 1:
+                raise StoragePermissionError("write permission denied")
+            if op.version == "*":
+                # If-not-exists write over an existing object.
+                raise StorageVersionError("version check failed")
+            if op.version and op.version != row["version"]:
+                raise StorageVersionError("version check failed")
+            await tx.execute(
+                "UPDATE storage SET value = ?, version = ?, read = ?,"
+                " write = ?, update_time = ?"
                 " WHERE collection = ? AND key = ? AND user_id = ?",
-                (op.collection, op.key, op.user_id),
+                (
+                    op.value,
+                    new_version,
+                    op.permission_read,
+                    op.permission_write,
+                    now,
+                    op.collection,
+                    op.key,
+                    op.user_id,
+                ),
             )
-            new_version = _version_of(op.value)
-            if row is None:
-                # Insert path: fails OCC if a specific version was expected.
-                if op.version and op.version != "*":
-                    raise StorageVersionError("version check failed")
-                await tx.execute(
-                    "INSERT INTO storage (collection, key, user_id, value,"
-                    " version, read, write, create_time, update_time)"
-                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
-                    (
-                        op.collection,
-                        op.key,
-                        op.user_id,
-                        op.value,
-                        new_version,
-                        op.permission_read,
-                        op.permission_write,
-                        now,
-                        now,
-                    ),
-                )
-            else:
-                if caller_id is not None and row["write"] != 1:
-                    raise StoragePermissionError("write permission denied")
-                if op.version == "*":
-                    # If-not-exists write over an existing object.
-                    raise StorageVersionError("version check failed")
-                if op.version and op.version != row["version"]:
-                    raise StorageVersionError("version check failed")
-                await tx.execute(
-                    "UPDATE storage SET value = ?, version = ?, read = ?,"
-                    " write = ?, update_time = ?"
-                    " WHERE collection = ? AND key = ? AND user_id = ?",
-                    (
-                        op.value,
-                        new_version,
-                        op.permission_read,
-                        op.permission_write,
-                        now,
-                        op.collection,
-                        op.key,
-                        op.user_id,
-                    ),
-                )
-            acks.append(
-                StorageAck(op.collection, op.key, op.user_id, new_version)
-            )
+        acks.append(
+            StorageAck(op.collection, op.key, op.user_id, new_version)
+        )
     return acks
 
 

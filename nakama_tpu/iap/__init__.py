@@ -1,0 +1,27 @@
+"""In-app-purchase receipt validation clients (reference iap/iap.go)."""
+
+from .client import (
+    ENV_PRODUCTION,
+    ENV_SANDBOX,
+    STORE_APPLE,
+    STORE_GOOGLE,
+    STORE_HUAWEI,
+    IAPError,
+    ValidatedPurchase,
+    validate_receipt_apple,
+    validate_receipt_google,
+    validate_receipt_huawei,
+)
+
+__all__ = [
+    "ENV_PRODUCTION",
+    "ENV_SANDBOX",
+    "IAPError",
+    "STORE_APPLE",
+    "STORE_GOOGLE",
+    "STORE_HUAWEI",
+    "ValidatedPurchase",
+    "validate_receipt_apple",
+    "validate_receipt_google",
+    "validate_receipt_huawei",
+]

@@ -103,6 +103,31 @@ class CpuBackend:
         return matched, expired, set()
 
 
+def _select_backend(config: MatchmakerConfig, logger, metrics):
+    """config.backend: "cpu" → oracle; "tpu" → device backend (raises
+    without one); "auto" → device backend only when an accelerator is the
+    default JAX device — CPU-only hosts (and the CPU-forced test env) get
+    the exact oracle, accelerator deployments get the production kernel
+    (SURVEY §7.5: the swappable-backends seam)."""
+    choice = getattr(config, "backend", "auto")
+    if choice == "cpu":
+        return CpuBackend()
+    use_device = choice == "tpu"
+    if choice == "auto":
+        try:
+            import jax
+
+            use_device = jax.devices()[0].platform not in ("cpu",)
+        except Exception:
+            use_device = False
+    if not use_device:
+        return CpuBackend()
+    from .tpu import TpuBackend
+
+    logger.info("matchmaker device backend selected")
+    return TpuBackend(config, logger, metrics)
+
+
 class LocalMatchmaker:
     def __init__(
         self,
@@ -117,7 +142,7 @@ class LocalMatchmaker:
         self.config = config
         self.metrics = metrics
         self.node = node
-        self.backend = backend or CpuBackend()
+        self.backend = backend or _select_backend(config, self.logger, metrics)
         self.on_matched = on_matched
         self.override_fn: OverrideFn | None = None
 

@@ -87,10 +87,16 @@ class TpuBackend:
         col_block: int = 2048,
         big_row_block: int = 1024,
         big_col_block: int = 1024,
+        tracing=None,
     ):
         self.config = config
         self.logger = logger.with_fields(subsystem="matchmaker.tpu")
         self.metrics = metrics
+        if tracing is None:
+            from ..tracing import Tracing
+
+            tracing = Tracing()
+        self.tracing = tracing
         cap = config.pool_capacity
         self.fn = config.numeric_fields
         self.fs = config.string_fields
@@ -350,6 +356,14 @@ class TpuBackend:
         selected: set[str] = set()
         work = None
         pipelined = self.config.interval_pipelining
+        # Per-interval observability breadcrumb (SURVEY §5: device timing
+        # breadcrumbs; the round-1 perf hole was diagnosed blind without
+        # these).
+        crumb: dict = {
+            "actives": len(actives),
+            "host_actives": len(host_actives),
+        }
+        span = self.tracing.span
         # Only work queued BEFORE this call may be collected this call:
         # this interval's own dispatch always gets at least one interval
         # of overlap (and tests rely on the deterministic lag).
@@ -380,8 +394,10 @@ class TpuBackend:
                 ],
                 dtype=np.uint8,
             )
-            self.pool.flush()
-            pending = self._dispatch(slots, rev_precision)
+            with span(crumb, "flush_s"):
+                self.pool.flush()
+            with span(crumb, "dispatch_s"):
+                pending = self._dispatch(slots, rev_precision)
             gen_snap = self._slot_gen.copy() if pipelined else self._slot_gen
             cohort = (
                 [t.ticket for t in device_actives] if pipelined else None
@@ -439,23 +455,26 @@ class TpuBackend:
             w_pending, w_slots, w_last_interval, w_n, w_gen, w_cohort = work
             if w_cohort is not None:
                 self._in_flight.difference_update(w_cohort)
-            cand_np = self._collect(w_pending, w_n)
-            n_matches, offsets, flat = native.assemble_arrays(
-                w_slots,
-                w_last_interval,
-                cand_np,
-                min_count=self.meta["min_count"],
-                max_count=self.meta["max_count"],
-                count_multiple=self.meta["count_multiple"],
-                count=self.meta["count"],
-                intervals=self.meta["intervals"],
-                created=self.meta["created"],
-                session_hashes=self.meta["session_hashes"],
-                session_counts=self.meta["session_counts"],
-            )
-            ok = self._validate_bulk(
-                n_matches, offsets, flat, rev_precision
-            )
+            with span(crumb, "collect_s"):
+                cand_np = self._collect(w_pending, w_n)
+            with span(crumb, "assemble_s"):
+                n_matches, offsets, flat = native.assemble_arrays(
+                    w_slots,
+                    w_last_interval,
+                    cand_np,
+                    min_count=self.meta["min_count"],
+                    max_count=self.meta["max_count"],
+                    count_multiple=self.meta["count_multiple"],
+                    count=self.meta["count"],
+                    intervals=self.meta["intervals"],
+                    created=self.meta["created"],
+                    session_hashes=self.meta["session_hashes"],
+                    session_counts=self.meta["session_counts"],
+                )
+            with span(crumb, "validate_s"):
+                ok = self._validate_bulk(
+                    n_matches, offsets, flat, rev_precision
+                )
             # Per-match accept/drop, vectorized: a Python loop over ~50k
             # matches with per-match numpy ops measured ~3s/interval on the
             # 100k bench — the aggregations below are O(total entries) numpy
@@ -503,6 +522,8 @@ class TpuBackend:
             selected.update(t.ticket for t in accepted)
 
         reactivate -= selected
+        crumb["matched_entries"] = sum(len(m) for m in matched)
+        self.tracing.record(crumb)
         return matched, expired, reactivate
 
     def wait_idle(self, timeout: float | None = None):

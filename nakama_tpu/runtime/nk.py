@@ -290,6 +290,43 @@ class NakamaModule:
         w = self._component("wallet")
         return await w.list_ledger(user_id, limit, cursor)
 
+    async def multi_update(
+        self,
+        wallet_updates: list[dict] | None = None,
+        storage_writes: list[dict] | None = None,
+        account_updates: list[dict] | None = None,
+        update_ledger: bool = True,
+    ) -> dict:
+        """Cross-entity transactional update (reference nk.MultiUpdate,
+        core_multi.go)."""
+        from ..core import storage as core_storage
+        from ..core.wallet import multi_update as _multi
+
+        ops = [
+            core_storage.StorageOpWrite(
+                collection=w["collection"],
+                key=w["key"],
+                user_id=w.get("user_id", ""),
+                value=(
+                    w["value"]
+                    if isinstance(w["value"], str)
+                    else json.dumps(w["value"])
+                ),
+                version=w.get("version", ""),
+                permission_read=int(w.get("permission_read", 1)),
+                permission_write=int(w.get("permission_write", 1)),
+            )
+            for w in storage_writes or []
+        ]
+        return await _multi(
+            self._db(),
+            self._component("wallet"),
+            wallet_updates=wallet_updates,
+            storage_writes=ops,
+            account_updates=account_updates,
+            update_ledger=update_ledger,
+        )
+
     # ------------------------------------------------------- notifications
 
     async def notification_send(

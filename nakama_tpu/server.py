@@ -110,10 +110,18 @@ class NakamaServer:
         from .core.channel import Channels
         from .core.friend import Friends
         from .core.group import Groups
+        from .core.notification import Notifications
+        from .core.wallet import Wallets
 
         self.channels = Channels(log, self.db, self.router)
-        self.friends = Friends(log, self.db)
+        self.notifications = Notifications(log, self.db, self.router)
+        self.wallets = Wallets(log, self.db)
+        self.friends = Friends(log, self.db, self.notifications)
         self.groups = Groups(log, self.db)
+
+        from .core.purchase import Purchases
+
+        self.purchases = Purchases(log, self.db, config)
         self.pipeline = Pipeline(
             log,
             Components(
@@ -162,8 +170,10 @@ class NakamaServer:
         self.leaderboards.on_change = self.leaderboard_scheduler.update
 
         from .api.http import ApiServer
+        from .console import ConsoleServer
 
         self.api = ApiServer(self)
+        self.console = ConsoleServer(self)
 
     def attach_runtime(self, runtime):
         """Wire the extensibility runtime into the pipeline, the matchmaker
@@ -225,6 +235,8 @@ class NakamaServer:
                 channels=self.channels,
                 friends=self.friends,
                 groups=self.groups,
+                notifications=self.notifications,
+                wallet=self.wallets,
             )
             self.attach_runtime(runtime)
         if self.runtime is not None:
@@ -239,7 +251,16 @@ class NakamaServer:
             self.config.socket.address or "127.0.0.1",
             self.config.socket.port if port is None else port,
         )
-        self.logger.info("server listening", port=self.port)
+        # Second listener for operators (reference StartConsoleServer,
+        # console.go:167). Port 0 in tests; collides with the API port
+        # guard only when explicitly equal.
+        self.console_port = await self.console.start(
+            self.config.console.address or "127.0.0.1",
+            0 if self.config.socket.port == 0 else self.config.console.port,
+        )
+        self.logger.info(
+            "server listening", port=self.port, console=self.console_port
+        )
 
     async def stop(self, grace_seconds: int | None = None):
         """Reverse-order shutdown draining matches first (main.go:209-240)."""
@@ -248,6 +269,7 @@ class NakamaServer:
             if grace_seconds is None
             else grace_seconds
         )
+        await self.console.stop()
         await self.api.stop()
         await self.match_registry.stop_all(grace)
         self.leaderboard_scheduler.stop()

@@ -99,6 +99,195 @@ class SocialClient:
         raise SocialError("apple verification unavailable")
 
 
+class HttpSocialClient(SocialClient):
+    """Production verifier: the reference's HTTPS flows (social.go) with
+    the network behind an injectable async `fetch(url) -> (status, bytes)`
+    so tests run offline and deployments can add caching/proxies. JWKS
+    documents are cached per URL with a TTL like the reference's in-client
+    JWKS caching."""
+
+    GOOGLE_JWKS = "https://www.googleapis.com/oauth2/v3/certs"
+    GOOGLE_ISSUERS = ("https://accounts.google.com", "accounts.google.com")
+    APPLE_JWKS = "https://appleid.apple.com/auth/keys"
+    APPLE_ISSUERS = ("https://appleid.apple.com",)
+    FACEBOOK_GRAPH = "https://graph.facebook.com/v11.0/me"
+    STEAM_AUTH = (
+        "https://partner.steam-api.com/ISteamUserAuth/"
+        "AuthenticateUserTicket/v1/"
+    )
+
+    def __init__(self, fetch=None, jwks_ttl_sec: float = 3600.0):
+        if fetch is None:
+            fetch = _aiohttp_fetch
+        self._fetch = fetch
+        self._jwks_cache: dict[str, tuple[float, dict]] = {}
+        self._jwks_ttl = jwks_ttl_sec
+
+    async def _jwks(self, url: str) -> dict:
+        import time as _time
+
+        cached = self._jwks_cache.get(url)
+        if cached is not None and cached[0] > _time.monotonic():
+            return cached[1]
+        status, body = await self._fetch(url)
+        if status != 200:
+            raise SocialError(f"JWKS fetch failed: HTTP {status}")
+        try:
+            jwks = json.loads(body)
+        except ValueError as e:
+            raise SocialError("JWKS fetch returned invalid JSON") from e
+        self._jwks_cache[url] = (
+            _time.monotonic() + self._jwks_ttl, jwks
+        )
+        return jwks
+
+    async def verify_google(self, token: str) -> SocialProfile:
+        """Google Sign-In id_token (reference social.go:370 CheckGoogleToken:
+        JWKS signature + issuer check)."""
+        from .verify import VerifyError, verify_id_token
+
+        try:
+            claims = verify_id_token(
+                token,
+                await self._jwks(self.GOOGLE_JWKS),
+                issuers=self.GOOGLE_ISSUERS,
+            )
+        except VerifyError as e:
+            raise SocialError(str(e)) from e
+        if not claims.get("sub"):
+            raise SocialError("google token missing subject")
+        return SocialProfile(
+            provider="google",
+            id=claims["sub"],
+            username=claims.get("given_name", ""),
+            display_name=claims.get("name", ""),
+            avatar_url=claims.get("picture", ""),
+            email=claims.get("email", ""),
+        )
+
+    async def verify_apple(self, bundle_id: str, token: str) -> SocialProfile:
+        """Sign in with Apple id_token (reference social.go:700
+        CheckAppleToken: JWKS + iss + aud=bundle id)."""
+        from .verify import VerifyError, verify_id_token
+
+        if not bundle_id:
+            raise SocialError("apple bundle id not configured")
+        try:
+            claims = verify_id_token(
+                token,
+                await self._jwks(self.APPLE_JWKS),
+                issuers=self.APPLE_ISSUERS,
+                audience=bundle_id,
+            )
+        except VerifyError as e:
+            raise SocialError(str(e)) from e
+        if not claims.get("sub"):
+            raise SocialError("apple token missing subject")
+        return SocialProfile(
+            provider="apple",
+            id=claims["sub"],
+            email=claims.get("email", ""),
+        )
+
+    async def verify_facebook(self, token: str) -> SocialProfile:
+        """Facebook Graph profile fetch (reference social.go:225
+        GetFacebookProfile)."""
+        import urllib.parse
+
+        url = (
+            f"{self.FACEBOOK_GRAPH}?fields=id,name,email,picture"
+            f"&access_token={urllib.parse.quote(token, safe='')}"
+        )
+        status, body = await self._fetch(url)
+        if status != 200:
+            raise SocialError(f"facebook token rejected: HTTP {status}")
+        try:
+            data = json.loads(body)
+        except ValueError as e:
+            raise SocialError("facebook graph returned invalid JSON") from e
+        if not data.get("id"):
+            raise SocialError("facebook token rejected")
+        return SocialProfile(
+            provider="facebook",
+            id=data["id"],
+            display_name=data.get("name", ""),
+            email=data.get("email", ""),
+        )
+
+    async def verify_steam(
+        self, app_id: int, publisher_key: str, token: str
+    ) -> SocialProfile:
+        """Steam session-ticket auth (reference social.go:610
+        CheckSteamToken via ISteamUserAuth)."""
+        import urllib.parse
+
+        if not app_id or not publisher_key:
+            raise SocialError("steam not configured")
+        q = urllib.parse.urlencode(
+            {"key": publisher_key, "appid": app_id, "ticket": token}
+        )
+        url = f"{self.STEAM_AUTH}?{q}"
+        status, body = await self._fetch(url)
+        if status != 200:
+            raise SocialError(f"steam auth failed: HTTP {status}")
+        try:
+            data = json.loads(body)
+        except ValueError as e:
+            raise SocialError("steam returned invalid JSON") from e
+        params = (data.get("response") or {}).get("params") or {}
+        if params.get("result") != "OK" or not params.get("steamid"):
+            raise SocialError("steam ticket rejected")
+        return SocialProfile(provider="steam", id=str(params["steamid"]))
+
+    async def verify_gamecenter(
+        self,
+        player_id: str,
+        bundle_id: str,
+        timestamp: int,
+        salt: str,
+        signature: str,
+        public_key_url: str,
+    ) -> SocialProfile:
+        """GameCenter signature verification (reference social.go:520):
+        the certificate URL must be an Apple HTTPS host, then RSA-SHA256
+        over playerId|bundleId|timestamp|salt."""
+        import urllib.parse
+
+        from .verify import VerifyError, verify_gamecenter_signature
+
+        if not (player_id and bundle_id and salt and signature):
+            raise SocialError("incomplete gamecenter credentials")
+        parsed = urllib.parse.urlsplit(public_key_url)
+        host = parsed.hostname or ""
+        if parsed.scheme != "https" or not (
+            host == "apple.com" or host.endswith(".apple.com")
+        ):
+            raise SocialError("invalid gamecenter public key url")
+        status, cert_der = await self._fetch(public_key_url)
+        if status != 200:
+            raise SocialError(
+                f"gamecenter certificate fetch failed: HTTP {status}"
+            )
+        try:
+            verify_gamecenter_signature(
+                cert_der,
+                player_id,
+                bundle_id,
+                timestamp,
+                base64.b64decode(salt),
+                base64.b64decode(signature),
+            )
+        except (VerifyError, ValueError) as e:
+            raise SocialError(str(e)) from e
+        return SocialProfile(provider="gamecenter", id=player_id)
+
+
+def _aiohttp_fetch(url: str):
+    from ..utils.httpfetch import fetch
+
+    return fetch(url)
+
+
 class StubSocialClient(SocialClient):
     """Offline deterministic verifier for tests/dev: `register(provider,
     token, profile)` then the matching verify_* accepts that token."""

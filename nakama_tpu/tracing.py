@@ -1,0 +1,72 @@
+"""Tracing + profiling.
+
+The reference ships none (SURVEY §5: OpenCensus remnants commented out,
+api.go:190) and the survey sets a higher bar for the TPU build: a
+jax.profiler trace server for on-demand device traces, plus cheap
+per-interval timing breadcrumbs so the matchmaker's device/host split is
+always observable in production (the round-1 perf hole was diagnosed
+blind for lack of exactly this).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import deque
+
+
+class Tracing:
+    def __init__(self, config=None, logger=None):
+        port = 0
+        capacity = 256
+        if config is not None:
+            port = getattr(config, "profiler_port", 0)
+            capacity = getattr(config, "breadcrumb_capacity", 256)
+        self.logger = logger
+        self._profiler_started = False
+        self.breadcrumbs: deque[dict] = deque(maxlen=capacity)
+        if port:
+            self.start_profiler_server(port)
+
+    # ------------------------------------------------------ trace server
+
+    def start_profiler_server(self, port: int):
+        """Expose the JAX profiler so `tensorboard --logdir` / xprof can
+        capture device traces from a live server."""
+        import jax
+
+        if self._profiler_started:
+            return
+        jax.profiler.start_server(port)
+        self._profiler_started = True
+        if self.logger is not None:
+            self.logger.info("jax profiler server started", port=port)
+
+    @contextlib.contextmanager
+    def device_trace(self, out_dir: str):
+        """Capture one jax.profiler trace around a block (used by
+        profile_interval.py and the console's on-demand capture)."""
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+
+    # ------------------------------------------------------- breadcrumbs
+
+    @contextlib.contextmanager
+    def span(self, crumb: dict, key: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            crumb[key] = crumb.get(key, 0.0) + time.perf_counter() - t0
+
+    def record(self, crumb: dict):
+        crumb.setdefault("ts", time.time())
+        self.breadcrumbs.append(crumb)
+
+    def recent(self, n: int = 32) -> list[dict]:
+        return list(self.breadcrumbs)[-n:]

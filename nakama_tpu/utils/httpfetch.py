@@ -1,0 +1,33 @@
+"""Shared outbound-HTTPS helper for the social/IAP clients.
+
+One pooled aiohttp session per process (lazily created, reset-safe across
+event loops) instead of a TCP+TLS handshake per verification call — the
+reference keeps one http.Client per social/iap client for the same
+reason (social/social.go NewClient)."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+_session = None
+_session_loop = None
+
+
+async def fetch(
+    url: str,
+    method: str = "GET",
+    headers: dict | None = None,
+    body: bytes | None = None,
+) -> tuple[int, bytes]:
+    global _session, _session_loop
+    import aiohttp
+
+    loop = asyncio.get_running_loop()
+    if _session is None or _session.closed or _session_loop is not loop:
+        _session = aiohttp.ClientSession()
+        _session_loop = loop
+    async with _session.request(
+        method, url, headers=headers, data=body
+    ) as resp:
+        return resp.status, await resp.read()

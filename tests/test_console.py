@@ -1,0 +1,213 @@
+"""Console admin API tests: own auth + lockout, status, redacted config,
+account browse/ban, storage browse, runtime info, API explorer
+(reference server/console.go, console_authenticate.go:73,
+console_api_explorer.go)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.server import NakamaServer
+
+
+async def make_server(modules=None):
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(
+        config, quiet_logger(), runtime_modules=modules or []
+    )
+    await server.start()
+    return server
+
+
+class Console:
+    def __init__(self, server):
+        self.base = f"http://127.0.0.1:{server.console_port}"
+        self.http = aiohttp.ClientSession()
+        self.token = ""
+
+    async def close(self):
+        await self.http.close()
+
+    async def login(self, username="admin", password="password"):
+        status, body = await self.call(
+            "POST",
+            "/v2/console/authenticate",
+            body={"username": username, "password": password},
+        )
+        if status == 200:
+            self.token = body["token"]
+        return status, body
+
+    async def call(self, method, path, body=None):
+        headers = (
+            {"Authorization": f"Bearer {self.token}"} if self.token else {}
+        )
+        async with self.http.request(
+            method, self.base + path, json=body, headers=headers
+        ) as resp:
+            return resp.status, await resp.json()
+
+
+async def test_console_auth_and_lockout():
+    server = await make_server()
+    console = Console(server)
+    try:
+        status, _ = await console.call("GET", "/v2/console/status")
+        assert status == 401
+
+        status, out = await console.login("admin", "wrong")
+        assert status == 401
+        status, out = await console.login()
+        assert status == 200 and out["role"] == 1
+
+        status, status_body = await console.call(
+            "GET", "/v2/console/status"
+        )
+        assert status == 200
+        assert status_body["name"] == server.config.name
+        assert "sessions" in status_body
+
+        # Repeated failures lock the account out.
+        for _ in range(10):
+            await console.login("admin", "wrong")
+        status, out = await console.login("admin", "wrong")
+        assert status in (401, 429)
+    finally:
+        await console.close()
+        await server.stop(0)
+
+
+async def test_console_config_redaction_and_runtime():
+    def init_module(ctx, logger, nk, initializer):
+        initializer.register_rpc("ping", lambda c, p: "pong")
+
+    server = await make_server([init_module])
+    console = Console(server)
+    try:
+        await console.login()
+        status, config = await console.call("GET", "/v2/console/config")
+        assert status == 200
+        assert config["session"]["encryption_key"] == "<redacted>"
+        assert config["socket"]["server_key"] == "<redacted>"
+        assert config["console"]["password"] == "<redacted>"
+        assert config["matchmaker"]["interval_sec"] == 15
+
+        status, rt = await console.call("GET", "/v2/console/runtime")
+        assert rt["loaded"] is True and rt["rpcs"] == ["ping"]
+
+        # API explorer invokes the rpc as console.
+        status, out = await console.call(
+            "POST", "/v2/console/api/endpoints/rpc/ping"
+        )
+        assert status == 200 and out["payload"] == "pong"
+    finally:
+        await console.close()
+        await server.stop(0)
+
+
+async def test_console_accounts_storage_and_ban():
+    server = await make_server()
+    console = Console(server)
+    try:
+        from nakama_tpu.core import authenticate as core_auth
+        from nakama_tpu.core.storage import StorageOpWrite
+        from nakama_tpu.core import storage as core_storage
+
+        uid, _, _ = await core_auth.authenticate_device(
+            server.db, "device-console-1", "watched", True
+        )
+        await core_storage.storage_write_objects(
+            server.db,
+            None,
+            [
+                StorageOpWrite(
+                    collection="saves", key="s1", user_id=uid,
+                    value='{"hp": 3}',
+                )
+            ],
+        )
+        await console.login()
+        status, users = await console.call(
+            "GET", "/v2/console/account?filter=watched"
+        )
+        assert status == 200
+        assert users["users"][0]["username"] == "watched"
+
+        status, account = await console.call(
+            "GET", f"/v2/console/account/{uid}"
+        )
+        assert account["user"]["username"] == "watched"
+        assert account["wallet"] == {}
+
+        status, objs = await console.call(
+            "GET", f"/v2/console/storage?user_id={uid}"
+        )
+        assert [o["key"] for o in objs["objects"]] == ["s1"]
+        status, obj = await console.call(
+            "GET", f"/v2/console/storage/saves/s1/{uid}"
+        )
+        assert json.loads(obj["value"]) == {"hp": 3}
+
+        # Ban kills sessions and blocks re-auth.
+        token = server.issue_session(uid, "watched")
+        status, _ = await console.call(
+            "POST", f"/v2/console/account/{uid}/ban"
+        )
+        assert status == 200
+        assert not server.session_cache.is_valid_session(uid, "whatever")
+        with pytest.raises(core_auth.AuthError):
+            await core_auth.authenticate_device(
+                server.db, "device-console-1", None, False
+            )
+        status, _ = await console.call(
+            "POST", f"/v2/console/account/{uid}/unban"
+        )
+        uid2, _, _ = await core_auth.authenticate_device(
+            server.db, "device-console-1", None, False
+        )
+        assert uid2 == uid
+    finally:
+        await console.close()
+        await server.stop(0)
+
+
+async def test_console_matchmaker_breadcrumbs():
+    """Device-backend breadcrumbs surface through the console (SURVEY §5
+    per-interval timing observability)."""
+    from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+    from nakama_tpu.matchmaker.tpu import TpuBackend
+
+    config = Config()
+    config.socket.port = 0
+    config.matchmaker.pool_capacity = 4096
+    config.matchmaker.big_pool_threshold = 1 << 30  # small exact kernel
+    server = NakamaServer(config, quiet_logger())
+    backend = TpuBackend(config.matchmaker, quiet_logger())
+    server.matchmaker.backend = backend
+    await server.start()
+    console = Console(server)
+    try:
+        for i in range(2):
+            p = MatchmakerPresence(user_id=f"u{i}", session_id=f"s{i}")
+            server.matchmaker.add(
+                [p], p.session_id, "", "*", 2, 2, 1, {}, {}
+            )
+        server.matchmaker.process()
+        await console.login()
+        status, out = await console.call("GET", "/v2/console/matchmaker")
+        assert status == 200
+        assert out["backend"] == "TpuBackend"
+        assert out["intervals"], "expected at least one breadcrumb"
+        crumb = out["intervals"][-1]
+        assert crumb["actives"] == 2
+        assert crumb["matched_entries"] == 2
+        assert "dispatch_s" in crumb and "collect_s" in crumb
+    finally:
+        await console.close()
+        await server.stop(0)

@@ -396,8 +396,17 @@ async def test_healthcheck_and_unimplemented():
     try:
         status, _ = await api.call("GET", "/healthcheck")
         assert status == 200
-        status, err = await api.call("GET", "/v2/notification")
-        assert status == 501 and err["code"] == 12
+        # Notifications are live now; the listing is empty but authorized.
+        _, session = await api.call(
+            "POST",
+            "/v2/account/authenticate/device",
+            headers=basic(),
+            body={"account": {"id": "device-health-1"}},
+        )
+        status, out = await api.call(
+            "GET", "/v2/notification", headers=bearer(session["token"])
+        )
+        assert status == 200 and out["notifications"] == []
     finally:
         await api.close()
         await server.stop(0)

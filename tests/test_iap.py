@@ -1,0 +1,145 @@
+"""IAP validation + purchase persistence tests with injected fetchers
+(reference iap/iap.go:150-166 prod→sandbox fallback, core_purchase.go
+seen-before semantics, core_subscription.go lifecycle)."""
+
+import json
+import time
+
+import pytest
+
+from fixtures import quiet_logger
+
+from nakama_tpu import iap
+from nakama_tpu.config import Config
+from nakama_tpu.core.purchase import Purchases
+from nakama_tpu.storage.db import Database
+
+
+def apple_fetch(prod_status=0, in_app=None, sandbox=False):
+    calls = []
+
+    async def fetch(url, method="GET", headers=None, body=None):
+        calls.append(url)
+        payload = json.loads(body)
+        assert payload["password"] == "shhh"
+        if url == iap.client.APPLE_PROD_URL and sandbox:
+            return 200, json.dumps(
+                {"status": iap.client.APPLE_SANDBOX_STATUS}
+            ).encode()
+        return 200, json.dumps(
+            {
+                "status": prod_status,
+                "receipt": {
+                    "in_app": in_app
+                    if in_app is not None
+                    else [
+                        {
+                            "transaction_id": "t-1",
+                            "product_id": "gold.pack",
+                            "purchase_date_ms": "1700000000000",
+                        }
+                    ]
+                },
+            }
+        ).encode()
+
+    fetch.calls = calls
+    return fetch
+
+
+async def test_apple_receipt_and_sandbox_fallback():
+    out = await iap.validate_receipt_apple(
+        "shhh", "b64receipt", fetch=apple_fetch()
+    )
+    assert out[0].transaction_id == "t-1"
+    assert out[0].environment == iap.ENV_PRODUCTION
+
+    fetch = apple_fetch(sandbox=True)
+    out = await iap.validate_receipt_apple("shhh", "b64receipt", fetch=fetch)
+    assert out[0].environment == iap.ENV_SANDBOX
+    assert fetch.calls == [
+        iap.client.APPLE_PROD_URL,
+        iap.client.APPLE_SANDBOX_URL,
+    ]
+
+    with pytest.raises(iap.IAPError):
+        await iap.validate_receipt_apple(
+            "shhh", "r", fetch=apple_fetch(prod_status=21003)
+        )
+    with pytest.raises(iap.IAPError):
+        await iap.validate_receipt_apple("", "r", fetch=apple_fetch())
+
+
+async def test_google_validation_flow():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ).decode()
+
+    async def fetch(url, method="GET", headers=None, body=None):
+        if url == iap.client.GOOGLE_TOKEN_URL:
+            assert b"assertion=" in body
+            return 200, json.dumps({"access_token": "at-1"}).encode()
+        assert headers["Authorization"] == "Bearer at-1"
+        return 200, json.dumps(
+            {
+                "purchaseState": 0,
+                "orderId": "GPA.123",
+                "purchaseTimeMillis": "1700000000000",
+                "purchaseType": 0,
+            }
+        ).encode()
+
+    receipt = json.dumps(
+        {
+            "packageName": "com.example",
+            "productId": "gems.10",
+            "purchaseToken": "ptok",
+        }
+    )
+    out = await iap.validate_receipt_google(
+        "svc@example.iam", pem, receipt, fetch=fetch
+    )
+    assert out[0].transaction_id == "GPA.123"
+    assert out[0].product_id == "gems.10"
+
+
+async def test_purchase_persistence_and_seen_before():
+    db = Database(":memory:")
+    await db.connect()
+    config = Config()
+    config.iap.apple_shared_password = "shhh"
+    p = Purchases(quiet_logger(), db, config, fetch=apple_fetch())
+    try:
+        first = await p.validate_apple("u1", "receipt")
+        assert first[0]["seen_before"] is False
+        again = await p.validate_apple("u1", "receipt")
+        assert again[0]["seen_before"] is True
+
+        listing = await p.list(user_id="u1")
+        assert len(listing["validated_purchases"]) == 1
+        assert (
+            listing["validated_purchases"][0]["product_id"] == "gold.pack"
+        )
+        got = await p.get_by_transaction("t-1")
+        assert got["user_id"] == "u1"
+
+        sub = await p.upsert_subscription(
+            "u1", "orig-1", "vip.monthly", iap.STORE_APPLE,
+            expire_time=time.time() + 3600,
+        )
+        assert sub["active"] is True
+        await p.upsert_subscription(
+            "u1", "orig-1", "vip.monthly", iap.STORE_APPLE,
+            expire_time=time.time() - 10,
+        )
+        subs = await p.list_subscriptions("u1")
+        assert len(subs["subscriptions"]) == 1
+        assert subs["subscriptions"][0]["active"] is False
+    finally:
+        await db.close()

@@ -1,0 +1,203 @@
+"""Notifications + wallet + MultiUpdate tests (reference
+core_notification.go:52-88, core_wallet.go:52, core_multi.go), including
+live routing over StreamModeNotifications through a real server."""
+
+import asyncio
+import json
+import time
+
+import pytest
+import websockets
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.core.notification import NotificationError, Notifications
+from nakama_tpu.core.storage import StorageOpWrite, StorageVersionError
+from nakama_tpu.core.wallet import WalletError, Wallets, multi_update
+from nakama_tpu.server import NakamaServer
+from nakama_tpu.storage.db import Database
+
+
+async def make_db(users=("ua", "ub")):
+    db = Database(":memory:")
+    await db.connect()
+    for uid in users:
+        await db.execute(
+            "INSERT INTO users (id, username, create_time, update_time)"
+            " VALUES (?, ?, 0, 0)",
+            (uid, f"name-{uid}"),
+        )
+    return db
+
+
+# -------------------------------------------------------------- wallets
+
+
+async def test_wallet_updates_and_ledger():
+    db = await make_db()
+    w = Wallets(quiet_logger(), db)
+    try:
+        results = await w.update_wallets(
+            [
+                {
+                    "user_id": "ua",
+                    "changeset": {"gold": 100, "gems": 5},
+                    "metadata": {"reason": "quest"},
+                }
+            ]
+        )
+        assert results[0]["previous"] == {}
+        assert results[0]["updated"] == {"gold": 100, "gems": 5}
+        assert await w.get("ua") == {"gold": 100, "gems": 5}
+
+        # Spend; negative aborts whole batch atomically.
+        with pytest.raises(WalletError):
+            await w.update_wallets(
+                [
+                    {"user_id": "ua", "changeset": {"gold": -10}},
+                    {"user_id": "ub", "changeset": {"gold": -1}},
+                ]
+            )
+        # First update rolled back with the batch.
+        assert (await w.get("ua"))["gold"] == 100
+
+        await w.update_wallets(
+            [{"user_id": "ua", "changeset": {"gold": -30}}]
+        )
+        assert (await w.get("ua"))["gold"] == 70
+
+        ledger, cursor = await w.list_ledger("ua")
+        assert len(ledger) == 2  # failed batch left no rows
+        assert ledger[0]["changeset"] == {"gold": -30}  # newest first
+        assert ledger[1]["metadata"] == {"reason": "quest"}
+
+        with pytest.raises(WalletError):
+            await w.update_wallets(
+                [{"user_id": "missing", "changeset": {"g": 1}}]
+            )
+        with pytest.raises(WalletError):
+            await w.update_wallets(
+                [{"user_id": "ua", "changeset": {"gold": 1.5}}]
+            )
+    finally:
+        await db.close()
+
+
+async def test_multi_update_atomicity():
+    db = await make_db()
+    w = Wallets(quiet_logger(), db)
+    try:
+        result = await multi_update(
+            db,
+            w,
+            wallet_updates=[{"user_id": "ua", "changeset": {"gold": 50}}],
+            storage_writes=[
+                StorageOpWrite(
+                    collection="inv", key="sword", user_id="ua",
+                    value='{"dmg": 7}',
+                )
+            ],
+            account_updates=[{"user_id": "ua", "display_name": "Hero"}],
+        )
+        assert result["wallets"][0]["updated"] == {"gold": 50}
+        assert result["storage_acks"][0]["key"] == "sword"
+        row = await db.fetch_one(
+            "SELECT display_name FROM users WHERE id = 'ua'"
+        )
+        assert row["display_name"] == "Hero"
+
+        # A failing storage OCC write rolls back the wallet delta too.
+        with pytest.raises(StorageVersionError):
+            await multi_update(
+                db,
+                w,
+                wallet_updates=[
+                    {"user_id": "ua", "changeset": {"gold": 1000}}
+                ],
+                storage_writes=[
+                    StorageOpWrite(
+                        collection="inv", key="sword", user_id="ua",
+                        value='{"dmg": 9}', version="bogus",
+                    )
+                ],
+            )
+        assert (await w.get("ua"))["gold"] == 50
+    finally:
+        await db.close()
+
+
+# -------------------------------------------------------- notifications
+
+
+async def test_notification_persist_list_delete():
+    db = await make_db()
+    n = Notifications(quiet_logger(), db)
+    try:
+        await n.send(
+            "ua", subject="welcome", content={"a": 1}, code=1,
+            persistent=True,
+        )
+        await n.send(
+            "ua", subject="ephemeral", content={}, code=2, persistent=False
+        )
+        await n.send(
+            "ub", subject="other-user", content={}, code=1, persistent=True
+        )
+        listing = await n.list("ua")
+        assert [x["subject"] for x in listing["notifications"]] == [
+            "welcome"
+        ]  # ephemeral + other-user not listed
+        cursor = listing["cacheable_cursor"]
+        assert cursor
+
+        # Cursor: nothing new yet; a later send shows up after the cursor.
+        again = await n.list("ua", cursor=cursor)
+        assert again["notifications"] == []
+        await n.send(
+            "ua", subject="later", content={}, code=3, persistent=True
+        )
+        newer = await n.list("ua", cursor=cursor)
+        assert [x["subject"] for x in newer["notifications"]] == ["later"]
+
+        ids = [x["id"] for x in (await n.list("ua"))["notifications"]]
+        # Deleting with the wrong owner is a no-op.
+        await n.delete("ub", ids)
+        assert len((await n.list("ua"))["notifications"]) == 2
+        await n.delete("ua", ids)
+        assert (await n.list("ua"))["notifications"] == []
+
+        with pytest.raises(NotificationError):
+            await n.send("ua", subject="", content={}, code=0)
+    finally:
+        await db.close()
+
+
+async def test_notifications_routed_live_over_ws():
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    try:
+        token = server.issue_session("u-live", "alice")
+        ws = await websockets.connect(
+            f"ws://127.0.0.1:{server.port}/ws?token={token}"
+        )
+        await asyncio.sleep(0.1)  # let tracking complete
+        await server.notifications.send(
+            "u-live",
+            subject="match starting",
+            content={"match": "m1"},
+            code=7,
+            persistent=True,
+        )
+        while True:
+            e = json.loads(await asyncio.wait_for(ws.recv(), 5))
+            if "notifications" in e:
+                break
+        batch = e["notifications"]["notifications"]
+        assert batch[0]["subject"] == "match starting"
+        assert batch[0]["code"] == 7
+        await ws.close()
+    finally:
+        await server.stop(0)

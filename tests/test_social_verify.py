@@ -1,0 +1,226 @@
+"""Social verifier tests with an injected fetcher: real RS256/JWKS and
+GameCenter signature crypto, offline (reference social/social.go:225-776
+flows)."""
+
+import base64
+import datetime
+import json
+import struct
+import time
+
+import pytest
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+from cryptography import x509
+from cryptography.x509.oid import NameOID
+
+from nakama_tpu.social.client import HttpSocialClient, SocialError
+
+
+def b64u(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+KEY = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def make_jwks(kid="k1"):
+    numbers = KEY.public_key().public_numbers()
+    return {
+        "keys": [
+            {
+                "kty": "RSA",
+                "kid": kid,
+                "alg": "RS256",
+                "n": b64u(
+                    numbers.n.to_bytes((numbers.n.bit_length() + 7) // 8,
+                                       "big")
+                ),
+                "e": b64u(
+                    numbers.e.to_bytes((numbers.e.bit_length() + 7) // 8,
+                                       "big")
+                ),
+            }
+        ]
+    }
+
+
+def sign_jwt(claims, kid="k1"):
+    header = {"alg": "RS256", "kid": kid, "typ": "JWT"}
+    signing = (
+        b64u(json.dumps(header).encode())
+        + "."
+        + b64u(json.dumps(claims).encode())
+    )
+    sig = KEY.sign(
+        signing.encode(), padding.PKCS1v15(), hashes.SHA256()
+    )
+    return signing + "." + b64u(sig)
+
+
+def fetcher(routes):
+    async def fetch(url):
+        for prefix, response in routes.items():
+            if url.startswith(prefix):
+                return response
+        return 404, b"not found"
+
+    return fetch
+
+
+async def test_google_id_token_roundtrip():
+    client = HttpSocialClient(
+        fetch=fetcher(
+            {
+                HttpSocialClient.GOOGLE_JWKS: (
+                    200,
+                    json.dumps(make_jwks()).encode(),
+                )
+            }
+        )
+    )
+    claims = {
+        "iss": "https://accounts.google.com",
+        "sub": "g-12345",
+        "name": "Alice Google",
+        "email": "a@example.com",
+        "exp": time.time() + 600,
+    }
+    profile = await client.verify_google(sign_jwt(claims))
+    assert profile.id == "g-12345"
+    assert profile.display_name == "Alice Google"
+
+    # Tampered signature rejected.
+    token = sign_jwt(claims)
+    with pytest.raises(SocialError):
+        await client.verify_google(token[:-6] + "AAAAAA")
+    # Wrong issuer rejected.
+    with pytest.raises(SocialError):
+        await client.verify_google(
+            sign_jwt({**claims, "iss": "https://evil.example"})
+        )
+    # Expired rejected.
+    with pytest.raises(SocialError):
+        await client.verify_google(
+            sign_jwt({**claims, "exp": time.time() - 10})
+        )
+
+
+async def test_apple_audience_check():
+    client = HttpSocialClient(
+        fetch=fetcher(
+            {
+                HttpSocialClient.APPLE_JWKS: (
+                    200,
+                    json.dumps(make_jwks()).encode(),
+                )
+            }
+        )
+    )
+    claims = {
+        "iss": "https://appleid.apple.com",
+        "sub": "apple-777",
+        "aud": "com.example.game",
+        "exp": time.time() + 600,
+    }
+    profile = await client.verify_apple("com.example.game", sign_jwt(claims))
+    assert profile.id == "apple-777"
+    with pytest.raises(SocialError):
+        await client.verify_apple("com.other.app", sign_jwt(claims))
+
+
+async def test_facebook_and_steam_flows():
+    fb_resp = {"id": "fb-1", "name": "Al", "email": "al@example.com"}
+    steam_resp = {
+        "response": {"params": {"result": "OK", "steamid": "7656119"}}
+    }
+    client = HttpSocialClient(
+        fetch=fetcher(
+            {
+                HttpSocialClient.FACEBOOK_GRAPH: (
+                    200,
+                    json.dumps(fb_resp).encode(),
+                ),
+                HttpSocialClient.STEAM_AUTH: (
+                    200,
+                    json.dumps(steam_resp).encode(),
+                ),
+            }
+        )
+    )
+    profile = await client.verify_facebook("tok")
+    assert profile.id == "fb-1"
+    profile = await client.verify_steam(480, "pubkey", "ticket")
+    assert profile.id == "7656119"
+
+    bad = HttpSocialClient(fetch=fetcher({}))
+    with pytest.raises(SocialError):
+        await bad.verify_facebook("tok")
+    with pytest.raises(SocialError):
+        await bad.verify_steam(480, "pubkey", "ticket")
+
+
+def make_gc_cert():
+    subject = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "gc.apple.com")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(subject)
+        .public_key(KEY.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .sign(KEY, hashes.SHA256())
+    )
+    return cert.public_bytes(serialization.Encoding.DER)
+
+
+async def test_gamecenter_signature():
+    cert_der = make_gc_cert()
+    client = HttpSocialClient(
+        fetch=fetcher(
+            {"https://static.gc.apple.com/public-key/gc-prod.cer": (
+                200, cert_der
+            )}
+        )
+    )
+    player, bundle, ts = "G:123", "com.example.game", 1700000000
+    salt = b"\x01\x02\x03\x04"
+    payload = (
+        player.encode() + bundle.encode() + struct.pack(">Q", ts) + salt
+    )
+    sig = KEY.sign(payload, padding.PKCS1v15(), hashes.SHA256())
+    profile = await client.verify_gamecenter(
+        player,
+        bundle,
+        ts,
+        base64.b64encode(salt).decode(),
+        base64.b64encode(sig).decode(),
+        "https://static.gc.apple.com/public-key/gc-prod.cer",
+    )
+    assert profile.id == player
+
+    # Wrong payload data -> signature mismatch.
+    with pytest.raises(SocialError):
+        await client.verify_gamecenter(
+            "G:999",
+            bundle,
+            ts,
+            base64.b64encode(salt).decode(),
+            base64.b64encode(sig).decode(),
+            "https://static.gc.apple.com/public-key/gc-prod.cer",
+        )
+    # Non-Apple cert host refused outright.
+    with pytest.raises(SocialError):
+        await client.verify_gamecenter(
+            player,
+            bundle,
+            ts,
+            base64.b64encode(salt).decode(),
+            base64.b64encode(sig).decode(),
+            "https://evil.example/key.cer",
+        )
