@@ -1,19 +1,22 @@
-"""Matchmaker benchmark — the north-star metric (BASELINE.md).
+"""Matchmaker benchmark — all five BASELINE.md configs + the north star.
 
-Measures p99 per-interval Process() latency on a large 1v1 rank-window
-ticket pool through the full production path: device kernel top-K →
-native C++ greedy assembler → match formation, with pool refill between
-intervals (steady-state shapes, compile excluded by warmup).
+Measures p99 per-interval Process() latency through the full production
+path: device kernel top-K → native C++ greedy assembler → match
+formation, with pool refill between intervals (steady-state shapes,
+compile excluded by warmup). The production cadence gives each interval
+IntervalSec (15s, reference config.go:973) of gap; the bench models the
+gap by waiting for the pipelined device pass to complete between timed
+calls instead of sleeping the full 15s.
 
-Baseline comparison: the reference publishes no numbers and its own 10k/100k
-benchmarks are commented out as impractical (reference
-server/matchmaker_test.go:2448-2471). We therefore measure OUR CPU oracle —
-a faithful re-statement of the reference algorithm — on a small pool of the
-same distribution and project quadratically to the benched pool size
-(both the reference's per-active TopN search and the combo assembly walk the
-whole pool). vs_baseline = projected_cpu_ms / measured_p99_ms.
+Baseline comparison: the reference publishes no numbers and its own
+10k/100k benchmarks are commented out as impractical (reference
+server/matchmaker_test.go:2448-2471). Config 1 (1k tickets) is compared
+DIRECTLY against our CPU oracle — a faithful re-statement of the
+reference algorithm — at the same pool size; larger configs project the
+oracle quadratically (both the reference's per-active TopN search and
+the combo assembly walk the whole pool).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line per config; the north-star 100k line is LAST.
 """
 
 from __future__ import annotations
@@ -23,17 +26,21 @@ import os
 import sys
 import time
 
-POOL = int(os.environ.get("BENCH_POOL", 100_000))
+NS_POOL = int(os.environ.get("BENCH_POOL", 100_000))
 ORACLE_POOL = int(os.environ.get("BENCH_ORACLE_POOL", 2_000))
 INTERVALS = int(os.environ.get("BENCH_INTERVALS", 20))
 WARMUP = int(os.environ.get("BENCH_WARMUP", 4))
+CFG_INTERVALS = int(os.environ.get("BENCH_CFG_INTERVALS", 10))
+CFG_WARMUP = int(os.environ.get("BENCH_CFG_WARMUP", 3))
+SCALE = float(os.environ.get("BENCH_SCALE", 1.0))  # shrink for smoke runs
+ONLY = os.environ.get("BENCH_ONLY", "")  # comma-separated config names
 
 
 def build_ticket(rng, i, prefix=""):
+    """North-star / config-1 shape: 1v1 rank-window + mode term."""
     mode = int(rng.integers(0, 8))
     rank = int(rng.integers(0, 1000))
     return dict(
-        user=f"{prefix}u{i}",
         query=(
             f"+properties.mode:m{mode} "
             f"+properties.rank:>={max(0, rank - 100)} "
@@ -41,41 +48,159 @@ def build_ticket(rng, i, prefix=""):
         ),
         strs={"mode": f"m{mode}"},
         nums={"rank": float(rank)},
+        min_count=2,
+        max_count=2,
     )
 
 
-def fill(mm, rng, n, prefix):
+def ticket_cfg1(rng, i):
+    """1k tickets, 2 numeric props (rank, region), min=max=2 — the CPU
+    parity baseline (BASELINE.md config 1)."""
+    rank = int(rng.integers(0, 1000))
+    region = int(rng.integers(0, 4))
+    return dict(
+        query=(
+            f"+properties.region:{region} "
+            f"+properties.rank:>={max(0, rank - 150)} "
+            f"+properties.rank:<={rank + 150}"
+        ),
+        nums={"rank": float(rank), "region": float(region)},
+        min_count=2,
+        max_count=2,
+    )
+
+
+def ticket_cfg2(rng, i):
+    """50k tickets, 8 numeric + 4 string props, min=3 max=4 (squad
+    fill)."""
+    mode = int(rng.integers(0, 4))
+    region = ("eu", "us", "ap", "sa")[int(rng.integers(0, 4))]
+    rank = int(rng.integers(0, 2000))
+    nums = {f"n{j}": float(rng.integers(0, 100)) for j in range(6)}
+    nums["rank"] = float(rank)
+    nums["level"] = float(rng.integers(1, 60))
+    return dict(
+        query=(
+            f"+properties.mode:m{mode} +properties.region:{region} "
+            f"+properties.rank:>={max(0, rank - 250)} "
+            f"+properties.rank:<={rank + 250}"
+        ),
+        strs={
+            "mode": f"m{mode}",
+            "region": region,
+            "platform": ("pc", "console")[int(rng.integers(0, 2))],
+            "input": ("kbm", "pad")[int(rng.integers(0, 2))],
+        },
+        nums=nums,
+        min_count=3,
+        max_count=4,
+    )
+
+
+def ticket_cfg3(rng, i):
+    """100k tickets, 16-dim skill embedding, min=max=10 (5v5 balance):
+    wildcard eligibility, similarity-ordered candidates."""
+    emb = rng.standard_normal(16).astype("float32")
+    emb /= max(1e-6, float((emb**2).sum()) ** 0.5)
+    return dict(
+        query="*",
+        embedding=emb,
+        min_count=10,
+        max_count=10,
+    )
+
+
+def ticket_cfg4(rng, i):
+    """50k mixed solo/party tickets with count_multiple=2 (party-aware,
+    reference party_handler.go:540)."""
+    mode = int(rng.integers(0, 4))
+    base = dict(
+        query=f"+properties.mode:m{mode}",
+        strs={"mode": f"m{mode}"},
+        min_count=2,
+        max_count=6,
+        count_multiple=2,
+    )
+    base["party_size"] = 2 if rng.random() < 0.3 else 1
+    return base
+
+
+def ticket_cfg5(rng, i):
+    """8 concurrent game-mode pools sharing one device batch; pool
+    separation rides the required-term mask plane (device2 string/pool
+    bucketing)."""
+    pool = int(rng.integers(0, 8))
+    rank = int(rng.integers(0, 1000))
+    return dict(
+        query=(
+            f"+properties.pool:p{pool} "
+            f"+properties.rank:>={max(0, rank - 100)} "
+            f"+properties.rank:<={rank + 100}"
+        ),
+        strs={"pool": f"p{pool}"},
+        nums={"rank": float(rank)},
+        min_count=2,
+        max_count=2,
+    )
+
+
+def fill(mm, rng, n, prefix, make_ticket=build_ticket):
     from nakama_tpu.matchmaker import MatchmakerPresence
 
     for i in range(n):
-        t = build_ticket(rng, i, prefix)
-        p = MatchmakerPresence(user_id=t["user"], session_id="s" + t["user"])
+        t = make_ticket(rng, i) if make_ticket is not build_ticket else (
+            build_ticket(rng, i, prefix)
+        )
+        party_size = t.get("party_size", 1)
+        presences = [
+            MatchmakerPresence(
+                user_id=f"{prefix}u{i}-{j}",
+                session_id=f"{prefix}s{i}-{j}",
+            )
+            for j in range(party_size)
+        ]
         mm.add(
-            [p], p.session_id, "", t["query"], 2, 2, 1, t["strs"], t["nums"]
+            presences,
+            presences[0].session_id,
+            f"{prefix}party{i}" if party_size > 1 else "",
+            t["query"],
+            t["min_count"],
+            t["max_count"],
+            t.get("count_multiple", 1),
+            t.get("strs", {}),
+            t.get("nums", {}),
+            embedding=t.get("embedding"),
         )
 
 
-def measure_oracle(rng):
-    """CPU-oracle time for one interval at ORACLE_POOL tickets."""
+def measure_oracle(rng, pool_n, make_ticket):
+    """CPU-oracle time for one interval at pool_n tickets."""
     from nakama_tpu.config import MatchmakerConfig
     from nakama_tpu.logger import test_logger
     from nakama_tpu.matchmaker import LocalMatchmaker
+    from nakama_tpu.matchmaker.local import CpuBackend
 
-    mm = LocalMatchmaker(test_logger(), MatchmakerConfig(max_intervals=2))
-    fill(mm, rng, ORACLE_POOL, "o")
+    mm = LocalMatchmaker(
+        test_logger(),
+        MatchmakerConfig(max_intervals=2, backend="cpu"),
+        backend=CpuBackend(),
+    )
+    fill(mm, rng, pool_n, "o", make_ticket)
     t0 = time.perf_counter()
     mm.process()
     return time.perf_counter() - t0
 
 
-def measure_device(rng):
+def measure_device(
+    rng, pool, make_ticket, intervals, warmup, **cfg_overrides
+):
     from nakama_tpu.config import MatchmakerConfig
     from nakama_tpu.logger import test_logger
     from nakama_tpu.matchmaker import LocalMatchmaker
     from nakama_tpu.matchmaker.tpu import TpuBackend
 
-    cap = 1 << (POOL + POOL // 2 - 1).bit_length()
-    cfg = MatchmakerConfig(
+    cap = 1 << (pool + pool // 2 - 1).bit_length()
+    defaults = dict(
         pool_capacity=cap,
         candidates_per_ticket=32,
         numeric_fields=8,
@@ -88,6 +213,8 @@ def measure_device(rng):
         # reference's 15s interval budget.
         interval_pipelining=True,
     )
+    defaults.update(cfg_overrides)
+    cfg = MatchmakerConfig(**defaults)
     backend = TpuBackend(cfg, test_logger(), row_block=256, col_block=2048)
     matched_total = [0]
     mm = LocalMatchmaker(
@@ -98,31 +225,24 @@ def measure_device(rng):
             0, matched_total[0] + sum(len(s) for s in sets)
         ),
     )
-    fill(mm, rng, POOL, "w")
+    fill(mm, rng, pool, "w", make_ticket)
 
     timings = []
-    for interval in range(INTERVALS):
-        deficit = POOL - len(mm)
-        if deficit:
-            fill(mm, rng, deficit, f"i{interval}-")
+    for interval in range(intervals):
+        deficit = pool - len(mm)
+        if deficit > 0:
+            fill(mm, rng, deficit, f"i{interval}-", make_ticket)
         t0 = time.perf_counter()
         mm.process()
         timings.append(time.perf_counter() - t0)
         if os.environ.get("BENCH_VERBOSE"):
             print(
-                f"interval {interval}: {timings[-1]*1000:.1f}ms",
+                f"  interval {interval}: {timings[-1]*1000:.1f}ms",
                 file=sys.stderr,
             )
-        # The production cadence gives each dispatched interval
-        # IntervalSec (15s, config.go:973) of gap before the next; the
-        # pipelined device pass + D2H completes inside it. Model the gap
-        # by its completion point instead of sleeping the full 15s —
-        # wall-clock honest (the wait is untimed idle, as in production)
-        # without a 15s x N bench runtime.
         backend.wait_idle()
-    # First intervals include jit compiles for new shape buckets and the
-    # pipeline warm-up; keep the steady tail (>=16 samples by default).
-    steady = sorted(timings[WARMUP:] or timings)
+    mm.stop()
+    steady = sorted(timings[warmup:] or timings)
     p99_ms = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1000
     median_ms = steady[len(steady) // 2] * 1000
     return p99_ms, median_ms, matched_total[0]
@@ -131,36 +251,92 @@ def measure_device(rng):
 def main():
     import numpy as np
 
-    rng = np.random.default_rng(42)
-
     import jax
 
     device = jax.devices()[0].platform
+    rng = np.random.default_rng(42)
 
-    oracle_s = measure_oracle(rng)
-    projected_cpu_ms = oracle_s * 1000 * (POOL / ORACLE_POOL) ** 2
+    oracle_s = measure_oracle(rng, ORACLE_POOL, build_ticket)
 
-    p99_ms, median_ms, matched = measure_device(rng)
+    def project(pool):
+        return oracle_s * 1000 * (pool / ORACLE_POOL) ** 2
 
-    print(
-        json.dumps(
-            {
-                "metric": f"matchmaker_process_p99_ms_{POOL // 1000}k",
-                "value": round(p99_ms, 2),
-                "unit": "ms",
-                "vs_baseline": round(projected_cpu_ms / p99_ms, 1),
-                "median_ms": round(median_ms, 2),
-                "entries_matched": matched,
-                "pool": POOL,
-                "device": device,
-                "baseline": (
-                    f"cpu-oracle {ORACLE_POOL} tickets = "
-                    f"{oracle_s * 1000:.0f}ms, projected quadratically to "
-                    f"{POOL} = {projected_cpu_ms:.0f}ms"
-                ),
-            }
+    def emit(name, pool, p99, median, matched, baseline_ms, note=""):
+        print(
+            json.dumps(
+                {
+                    "metric": name,
+                    "value": round(p99, 2),
+                    "unit": "ms",
+                    "vs_baseline": round(baseline_ms / max(p99, 1e-9), 1),
+                    "median_ms": round(median, 2),
+                    "entries_matched": matched,
+                    "pool": pool,
+                    "device": device,
+                    "baseline": note,
+                }
+            ),
+            flush=True,
         )
-    )
+
+    configs = [
+        # (name, pool, maker, overrides)
+        ("cfg1_1k_1v1_parity", int(1000 * SCALE) or 1000, ticket_cfg1, {}),
+        # 8 user numeric props + 3 builtin columns (min/max_count,
+        # created_at) need 12 numeric field slots.
+        (
+            "cfg2_50k_squad_fill",
+            int(50_000 * SCALE),
+            ticket_cfg2,
+            {"numeric_fields": 12},
+        ),
+        (
+            "cfg3_100k_embedding_5v5",
+            int(100_000 * SCALE),
+            ticket_cfg3,
+            {"candidates_per_ticket": 64},
+        ),
+        ("cfg4_50k_party_multiple", int(50_000 * SCALE), ticket_cfg4, {}),
+        ("cfg5_8x20k_multipool", int(160_000 * SCALE), ticket_cfg5, {}),
+    ]
+    only = {s.strip() for s in ONLY.split(",") if s.strip()}
+    for name, pool, maker, overrides in configs:
+        if only and not any(sel in name for sel in only):
+            continue
+        if os.environ.get("BENCH_VERBOSE"):
+            print(f"{name}: pool={pool}", file=sys.stderr)
+        p99, median, matched = measure_device(
+            rng, pool, maker, CFG_INTERVALS, CFG_WARMUP, **overrides
+        )
+        if name.startswith("cfg1"):
+            direct = measure_oracle(rng, pool, ticket_cfg1) * 1000
+            note = f"cpu-oracle measured directly at {pool}: {direct:.0f}ms"
+            baseline = direct
+        else:
+            baseline = project(pool)
+            note = (
+                f"cpu-oracle {ORACLE_POOL} = {oracle_s*1000:.0f}ms,"
+                f" projected quadratically to {pool} = {baseline:.0f}ms"
+            )
+        emit(name, pool, p99, median, matched, baseline, note)
+
+    if not only or "north" in only or "100k" in only:
+        p99, median, matched = measure_device(
+            rng, NS_POOL, build_ticket, INTERVALS, WARMUP
+        )
+        emit(
+            f"matchmaker_process_p99_ms_{NS_POOL // 1000}k",
+            NS_POOL,
+            p99,
+            median,
+            matched,
+            project(NS_POOL),
+            (
+                f"cpu-oracle {ORACLE_POOL} tickets = {oracle_s*1000:.0f}ms,"
+                f" projected quadratically to {NS_POOL} ="
+                f" {project(NS_POOL):.0f}ms"
+            ),
+        )
 
 
 if __name__ == "__main__":

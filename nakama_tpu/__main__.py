@@ -1,0 +1,68 @@
+"""CLI dispatch (reference main.go:64: `--version`, `migrate`, `check`,
+else run the server)."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+
+def _migrate(argv: list[str]) -> int:
+    """`migrate up|status` against the configured database (reference
+    migrate/migrate.go CLI; down-migrations are not supported by design —
+    the embedded engine is forward-only, matching sql-migrate's safe
+    default posture)."""
+    from .config import parse_args
+    from .storage.db import Database, migrate_status
+
+    sub = argv[0] if argv else "status"
+    config = parse_args(argv[1:])
+    db = Database((config.database.address or [":memory:"])[0])
+
+    async def run():
+        if sub == "up":
+            await db.connect()  # connect applies pending migrations
+            rows = await migrate_status(db)
+        elif sub == "status":
+            await db.connect()
+            rows = await migrate_status(db)
+        else:
+            print(f"unknown migrate subcommand: {sub}", file=sys.stderr)
+            return 2
+        for row in rows:
+            print(
+                f"{row['version']:>3}  {row['name']:<24} "
+                f"{'applied' if row.get('applied_at') else 'pending'}"
+            )
+        await db.close()
+        return 0
+
+    return asyncio.run(run())
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--version":
+        from . import __version__
+
+        print(__version__)
+        return 0
+    if argv and argv[0] == "migrate":
+        return _migrate(argv[1:])
+    if argv and argv[0] == "check":
+        from .config import parse_args
+
+        config = parse_args(argv[1:])
+        warnings = config.check()
+        for warning in warnings:
+            print(f"warning: {warning}")
+        print("config ok" + (f" ({len(warnings)} warnings)" if warnings else ""))
+        return 0
+    from .server import main as server_main
+
+    server_main(argv)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

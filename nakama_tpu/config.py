@@ -93,6 +93,11 @@ class MatchmakerConfig:
     # blockwise top-K kernel to the two-stage MXU kernel (device2.py).
     big_pool_threshold: int = 32_768
     emb_score_scale: float = 256.0  # stage-1 embedding-score quantisation
+    # Shard the pool's column axis over this many devices (0 = single
+    # device; -1 = all visible devices). Per-interval merge rides ICI
+    # collectives (SURVEY §2.8); capacity must split into col_block-sized
+    # shards.
+    mesh_devices: int = 0
     # Pipelined intervals: process() collects the PREVIOUS interval's device
     # results and dispatches the current one, hiding device+transfer latency
     # entirely. Ticket properties are immutable so candidate eligibility
@@ -158,6 +163,14 @@ class IAPConfig:
 
 
 @dataclass
+class SatoriConfig:
+    url: str = ""
+    api_key_name: str = ""
+    api_key: str = ""
+    signing_key: str = ""
+
+
+@dataclass
 class SocialConfig:
     steam_app_id: int = 0
     steam_publisher_key: str = ""
@@ -183,6 +196,7 @@ class Config:
     leaderboard: LeaderboardConfig = field(default_factory=LeaderboardConfig)
     iap: IAPConfig = field(default_factory=IAPConfig)
     social: SocialConfig = field(default_factory=SocialConfig)
+    satori: SatoriConfig = field(default_factory=SatoriConfig)
 
     @property
     def node(self) -> str:

@@ -55,6 +55,9 @@ class ConsoleServer:
         r.add_post("/v2/console/account/{id}/ban", self._h_account_ban)
         r.add_post("/v2/console/account/{id}/unban", self._h_account_unban)
         r.add_delete("/v2/console/account/{id}", self._h_account_delete)
+        r.add_get(
+            "/v2/console/account/{id}/export", self._h_account_export
+        )
         r.add_get("/v2/console/storage", self._h_storage_list)
         r.add_get(
             "/v2/console/storage/{collection}/{key}/{user_id}",
@@ -266,6 +269,20 @@ class ConsoleServer:
         )
         self.server.session_cache.unban([user_id])
         return web.json_response({})
+
+    async def _h_account_export(self, request: web.Request):
+        """GDPR-style account export (reference ExportAccount via
+        console_account.go)."""
+        self._auth(request)
+        from ..core import account as core_account
+
+        try:
+            export = await core_account.export_account(
+                self.server.db, request.match_info["id"]
+            )
+        except core_auth.AuthError:
+            return _err(404, "account not found")
+        return web.json_response(export)
 
     async def _h_account_delete(self, request: web.Request):
         self._auth(request, write=True)

@@ -106,6 +106,54 @@ async def delete_account(
             )
 
 
+async def export_account(db: Database, user_id: str) -> dict:
+    """Everything the server holds about one user in one JSON document
+    (reference ExportAccount, core_account.go: account + storage objects +
+    wallet ledger + friends + groups + messages + leaderboard records)."""
+    account = await get_account(db, user_id)
+    objects = await db.fetch_all(
+        "SELECT collection, key, value, version, read, write, create_time,"
+        " update_time FROM storage WHERE user_id = ?",
+        (user_id,),
+    )
+    ledger = await db.fetch_all(
+        "SELECT id, changeset, metadata, create_time FROM wallet_ledger"
+        " WHERE user_id = ? ORDER BY create_time",
+        (user_id,),
+    )
+    friends = await db.fetch_all(
+        "SELECT destination_id, state, update_time FROM user_edge"
+        " WHERE source_id = ?",
+        (user_id,),
+    )
+    groups = await db.fetch_all(
+        "SELECT source_id AS group_id, state, update_time FROM group_edge"
+        " WHERE destination_id = ?",
+        (user_id,),
+    )
+    messages = await db.fetch_all(
+        "SELECT id, code, content, create_time, stream_mode,"
+        " stream_subject, stream_subcontext, stream_label FROM message"
+        " WHERE sender_id = ? ORDER BY create_time",
+        (user_id,),
+    )
+    records = await db.fetch_all(
+        "SELECT leaderboard_id, score, subscore, num_score, metadata,"
+        " create_time, update_time, expiry_time FROM leaderboard_record"
+        " WHERE owner_id = ?",
+        (user_id,),
+    )
+    return {
+        "account": account,
+        "objects": [dict(r) for r in objects],
+        "wallet_ledgers": [dict(r) for r in ledger],
+        "friends": [dict(r) for r in friends],
+        "groups": [dict(r) for r in groups],
+        "messages": [dict(r) for r in messages],
+        "leaderboard_records": [dict(r) for r in records],
+    }
+
+
 async def get_users(
     db: Database,
     user_ids: list[str] | None = None,

@@ -106,26 +106,37 @@ class Notifications:
 
     async def send_all(
         self, subject: str, content: dict, code: int,
-        persistent: bool = False,
+        persistent: bool = False, batch_size: int = 1000,
     ) -> int:
-        """Deliver to EVERY user account (reference NotificationSendAll
+        """Deliver to EVERY user account, paginated so a broadcast never
+        materializes the whole user table or holds one giant transaction
+        (reference NotificationSendAll processes in batches,
         core_notification.go:88)."""
-        rows = await self.db.fetch_all(
-            "SELECT id FROM users WHERE disable_time = 0"
-        )
-        batch = [
-            {
-                "user_id": r["id"],
-                "subject": subject,
-                "content": content,
-                "code": code,
-                "persistent": persistent,
-            }
-            for r in rows
-        ]
-        if batch:
-            await self.send_many(batch)
-        return len(batch)
+        total = 0
+        last_id = ""
+        while True:
+            rows = await self.db.fetch_all(
+                "SELECT id FROM users WHERE disable_time = 0 AND id > ?"
+                " ORDER BY id LIMIT ?",
+                (last_id, batch_size),
+            )
+            if not rows:
+                break
+            last_id = rows[-1]["id"]
+            await self.send_many(
+                [
+                    {
+                        "user_id": r["id"],
+                        "subject": subject,
+                        "content": content,
+                        "code": code,
+                        "persistent": persistent,
+                    }
+                    for r in rows
+                ]
+            )
+            total += len(rows)
+        return total
 
     async def list(
         self, user_id: str, limit: int = 100, cursor: str = ""

@@ -31,7 +31,7 @@ class Purchases:
         validated = await validate_receipt_apple(
             self.config.iap.apple_shared_password, receipt, self._fetch
         )
-        return await self._store(user_id, validated, persist)
+        return await self._store(user_id, validated, persist, receipt)
 
     async def validate_google(
         self, user_id: str, receipt: str, persist: bool = True
@@ -44,7 +44,7 @@ class Purchases:
             receipt,
             self._fetch,
         )
-        return await self._store(user_id, validated, persist)
+        return await self._store(user_id, validated, persist, receipt)
 
     async def validate_huawei(
         self, user_id: str, receipt: str, persist: bool = True
@@ -57,16 +57,18 @@ class Purchases:
             receipt,
             self._fetch,
         )
-        return await self._store(user_id, validated, persist)
+        return await self._store(user_id, validated, persist, receipt)
 
     async def _store(
         self,
         user_id: str,
         validated: list[ValidatedPurchase],
         persist: bool,
+        raw_receipt: str = "",
     ) -> list[dict]:
         now = time.time()
         seen: dict[str, bool] = {}
+        owner_of: dict[str, str] = {}
         if persist:
             # One transaction for the whole receipt: a multi-item receipt
             # persists atomically, so a retried validation can't misreport
@@ -80,6 +82,12 @@ class Purchases:
                         (v.transaction_id,),
                     )
                     seen[v.transaction_id] = row is not None
+                    if row is not None:
+                        # Replay detection must report the STORED owner —
+                        # user B re-submitting user A's receipt sees A's
+                        # association, not a phantom grant (reference
+                        # returns the stored purchase row).
+                        owner_of[v.transaction_id] = row["user_id"]
                     if row is None:
                         await tx.execute(
                             "INSERT INTO purchase (user_id, transaction_id,"
@@ -93,9 +101,22 @@ class Purchases:
                                 v.purchase_time, now, now, v.environment,
                             ),
                         )
+                        if raw_receipt:
+                            # Raw receipt retained for re-validation and
+                            # refund audits (purchase_receipt table).
+                            await tx.execute(
+                                "INSERT OR IGNORE INTO purchase_receipt"
+                                " (transaction_id, user_id, store,"
+                                " receipt, create_time)"
+                                " VALUES (?, ?, ?, ?, ?)",
+                                (
+                                    v.transaction_id, user_id, v.store,
+                                    raw_receipt, now,
+                                ),
+                            )
         return [
             {
-                "user_id": user_id,
+                "user_id": owner_of.get(v.transaction_id, user_id),
                 "transaction_id": v.transaction_id,
                 "product_id": v.product_id,
                 "store": v.store,

@@ -184,9 +184,16 @@ async def validate_receipt_google(
     if not access_token:
         raise IAPError("google token grant returned no access token")
 
+    import urllib.parse as _up
+
+    # Client-controlled path components MUST be escaped or a crafted
+    # purchaseToken steers the service-account-authenticated GET to an
+    # attacker-chosen googleapis path.
     url = (
         f"{GOOGLE_PUBLISHER_URL}/androidpublisher/v3/applications/"
-        f"{package}/purchases/products/{product_id}/tokens/{token}"
+        f"{_up.quote(package, safe='')}/purchases/products/"
+        f"{_up.quote(product_id, safe='')}/tokens/"
+        f"{_up.quote(token, safe='')}"
     )
     status, body = await fetch(
         url, headers={"Authorization": f"Bearer {access_token}"}

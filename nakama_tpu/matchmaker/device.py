@@ -113,13 +113,36 @@ class PoolBuffer:
         d: int = 16,
         flush_chunk: int = 2048,
         on_flush=None,
+        sharding=None,
     ):
         self.capacity = capacity
         self.fn, self.fs, self.s, self.d = fn, fs, s, d
         self.flush_chunk = flush_chunk
         self.on_flush = on_flush
+        self.sharding = sharding
         host = pool_schema(capacity, fn, fs, s, d)
-        self.device = jax.tree.map(jnp.asarray, host)
+        if sharding is not None:
+            # Slot axis sharded over the mesh; scatters preserve placement
+            # via jit out_shardings below.
+            self.device = {
+                k: jax.device_put(v, sharding) for k, v in host.items()
+            }
+            self._scatter = jax.jit(
+                lambda pool, idx, rows: {
+                    k: pool[k].at[idx].set(rows[k]) for k in pool
+                },
+                donate_argnums=(0,),
+                out_shardings=sharding,
+            )
+            self._invalidate = jax.jit(
+                _invalidate.__wrapped__,
+                donate_argnums=(0,),
+                out_shardings=sharding,
+            )
+        else:
+            self.device = jax.tree.map(jnp.asarray, host)
+            self._scatter = _scatter
+            self._invalidate = _invalidate
         # LIFO free list popping slot 0 first: the pool stays dense at the
         # low end, so the kernel can stop at the high-water mark.
         self._free = list(range(capacity - 1, -1, -1))
@@ -197,7 +220,7 @@ class PoolBuffer:
             u = len(rm_idx)
             u_pad = _pad(u)
             idx = np.asarray(rm_idx + [rm_idx[-1]] * (u_pad - u), np.int32)
-            self.device = _invalidate(self.device, jnp.asarray(idx))
+            self.device = self._invalidate(self.device, jnp.asarray(idx))
 
         if add_items:
             u = len(add_items)
@@ -211,7 +234,7 @@ class PoolBuffer:
             stacked = {
                 k: np.stack([r[k] for r in rows]) for k in self.device
             }
-            self.device = _scatter(
+            self.device = self._scatter(
                 self.device,
                 jnp.asarray(idx),
                 jax.tree.map(jnp.asarray, stacked),

@@ -117,11 +117,50 @@ class TpuBackend:
 
         self.d = config.embedding_dims
         self.registry = FieldRegistry(self.fn, self.fs)
+
+        # Multi-device: shard the pool's slot axis over a mesh; dispatch
+        # runs the blockwise kernel per shard and merges over ICI
+        # (SURVEY §2.8; parallel/mesh.py). Opt-in via config.mesh_devices.
+        self._mesh = None
+        mesh_n = getattr(config, "mesh_devices", 0)
+        if mesh_n:
+            n_dev = len(jax.devices()) if mesh_n < 0 else mesh_n
+            if len(jax.devices()) < n_dev:
+                raise ValueError(
+                    f"mesh_devices={n_dev} but only "
+                    f"{len(jax.devices())} devices visible"
+                )
+            if cap % n_dev or (cap // n_dev) % self.col_block:
+                raise ValueError(
+                    "pool_capacity must split into col_block-sized shards "
+                    f"across {n_dev} devices"
+                )
+            from ..parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(n_dev)
+
+        sharding = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(self._mesh, PartitionSpec("pool"))
         self.pool = PoolBuffer(
             cap, self.fn, self.fs, self.s, self.d,
             on_flush=self._observe_chunk,
+            sharding=sharding,
         )
-        self._interpret = jax.devices()[0].platform != "tpu"
+        self._interpret = jax.devices()[0].platform not in ("tpu",)
+        self._gather_rows = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            replicated = NamedSharding(self._mesh, PartitionSpec())
+            self._gather_rows = jax.jit(
+                lambda pool, safe: {
+                    key: v[safe] for key, v in pool.items()
+                },
+                out_shardings=replicated,
+            )
 
         # Host-side per-slot metadata for the native assembler.
         sps = config.max_party_size
@@ -262,6 +301,17 @@ class TpuBackend:
             self._created_base = ticket.created_seq
         if host_only:
             self.host_only.add(ticket.ticket)
+            # The host fallback is O(actives x pool) Python — fine for a
+            # handful of exotic queries, catastrophic if schema overflow
+            # sends the whole pool here. Make that loud.
+            n = len(self.host_only)
+            if n in (100, 1000, 10_000):
+                self.logger.warn(
+                    "host-only matchmaker tickets piling up — check "
+                    "numeric_fields/string_fields/max_constraints sizing "
+                    "(3 numeric + 2 string slots are builtin)",
+                    count=n,
+                )
         if cq is not None and cq.has_should:
             self._should_tickets.add(ticket.ticket)
         if ticket.embedding is not None:
@@ -544,6 +594,10 @@ class TpuBackend:
         hw = self.pool.high_water
         with_should = bool(self._should_tickets)
         with_embedding = bool(self._embedding_tickets)
+        if self._mesh is not None:
+            return self._dispatch_sharded(
+                slots, rev, with_should, with_embedding
+            )
         big = hw >= self.config.big_pool_threshold
 
         if big:
@@ -619,6 +673,39 @@ class TpuBackend:
             with_should=with_should,
             with_embedding=with_embedding,
             created_base=np.int32(self._created_base),
+        )
+        return ("small", scores, cand)
+
+    def _dispatch_sharded(
+        self, slots: np.ndarray, rev: bool, with_should: bool,
+        with_embedding: bool,
+    ):
+        """Multi-device interval: every device scores all active rows
+        against ITS column shard of the pool, partial top-Ks merge over
+        ICI (parallel/mesh.py; SURVEY §2.8). Returns the small-path
+        pending shape so collection/assembly are shared."""
+        import jax.numpy as jnp
+
+        from ..parallel.mesh import sharded_topk_rows
+
+        br = self.row_block
+        n_blocks = -(-len(slots) // br)
+        a_pad = br * (1 << max(0, n_blocks - 1).bit_length())
+        pad_slots = pad_to(slots, a_pad, -1)
+        safe = jnp.asarray(np.maximum(pad_slots, 0))
+        rows = dict(self._gather_rows(self.pool.device, safe))
+        rows["_valid"] = jnp.asarray((pad_slots >= 0).astype(np.int32))
+        rows["_slot"] = jnp.asarray(pad_slots.astype(np.int32))
+        scores, cand = sharded_topk_rows(
+            self._mesh,
+            self.pool.device,
+            rows,
+            k=min(self.k, self.pool.capacity),
+            br=br,
+            bc=self.col_block,
+            rev=rev,
+            with_should=with_should,
+            with_embedding=with_embedding,
         )
         return ("small", scores, cand)
 

@@ -608,6 +608,24 @@ class NakamaModule:
         m = self._component("metrics")
         m.timer_record(name, value_ms / 1000.0, **(tags or {}))
 
+    # -------------------------------------------------------------- satori
+
+    def get_satori(self):
+        """The LiveOps client (reference nk.GetSatori,
+        runtime_go_nakama.go); unconfigured clients raise on use so
+        modules can feature-gate."""
+        from ..social.satori import SatoriClient
+
+        sc = getattr(self.config, "satori", None)
+        if getattr(self, "_satori", None) is None:
+            self._satori = SatoriClient(
+                url=getattr(sc, "url", ""),
+                api_key_name=getattr(sc, "api_key_name", ""),
+                api_key=getattr(sc, "api_key", ""),
+                signing_key=getattr(sc, "signing_key", ""),
+            )
+        return self._satori
+
     # ----------------------------------------------------------- utilities
     # (reference nk crypto/codec helpers, runtime_go_nakama.go)
 

@@ -272,4 +272,23 @@ MIGRATIONS: list[tuple[int, str, list[str]]] = [
             "CREATE INDEX IF NOT EXISTS subscription_user_idx ON subscription (user_id, purchase_time)",
         ],
     ),
+    (
+        6,
+        "purchase-receipts",
+        [
+            # reference migrate/sql purchase_receipt: the raw store
+            # receipt blob keyed by transaction, kept for re-validation
+            # and refund audits.
+            """
+            CREATE TABLE IF NOT EXISTS purchase_receipt (
+                transaction_id TEXT PRIMARY KEY,
+                user_id        TEXT NOT NULL,
+                store          INTEGER NOT NULL,
+                receipt        TEXT NOT NULL,
+                create_time    REAL NOT NULL
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS purchase_receipt_user_idx ON purchase_receipt (user_id, create_time)",
+        ],
+    ),
 ]

@@ -1,17 +1,33 @@
 """Shared outbound-HTTPS helper for the social/IAP clients.
 
-One pooled aiohttp session per process (lazily created, reset-safe across
-event loops) instead of a TCP+TLS handshake per verification call — the
-reference keeps one http.Client per social/iap client for the same
-reason (social/social.go NewClient)."""
+One pooled aiohttp session per event loop (the reference keeps one
+http.Client per social/iap client for the same reason,
+social/social.go NewClient). Sessions for dead loops are closed
+best-effort so loop churn (tests, restarts) doesn't leak connectors.
+"""
 
 from __future__ import annotations
 
 import asyncio
+import inspect
 
 
-_session = None
-_session_loop = None
+_sessions: dict[int, object] = {}
+
+
+def _reap_dead_sessions(current_key: int):
+    for key, sess in list(_sessions.items()):
+        if key == current_key:
+            continue
+        loop = getattr(sess, "_loop", None)
+        if loop is None or loop.is_closed():
+            _sessions.pop(key, None)
+            try:
+                result = sess.connector.close()
+                if inspect.iscoroutine(result):
+                    result.close()  # sync-close path; drop the coroutine
+            except Exception:
+                pass
 
 
 async def fetch(
@@ -20,14 +36,16 @@ async def fetch(
     headers: dict | None = None,
     body: bytes | None = None,
 ) -> tuple[int, bytes]:
-    global _session, _session_loop
     import aiohttp
 
     loop = asyncio.get_running_loop()
-    if _session is None or _session.closed or _session_loop is not loop:
-        _session = aiohttp.ClientSession()
-        _session_loop = loop
-    async with _session.request(
+    key = id(loop)
+    session = _sessions.get(key)
+    if session is None or session.closed:
+        session = aiohttp.ClientSession()
+        _sessions[key] = session
+        _reap_dead_sessions(key)
+    async with session.request(
         method, url, headers=headers, data=body
     ) as resp:
         return resp.status, await resp.read()

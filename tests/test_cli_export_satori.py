@@ -1,0 +1,81 @@
+"""CLI dispatch, account export, purchase receipts, satori client
+(reference main.go:64, core_account.go ExportAccount, purchase_receipt
+table, internal/satori/satori.go)."""
+
+import json
+
+import pytest
+
+from fixtures import quiet_logger
+
+from nakama_tpu.core import account as core_account
+from nakama_tpu.core import authenticate as core_auth
+from nakama_tpu.social.satori import SatoriClient, SatoriError
+from nakama_tpu.storage.db import Database, migrate_status
+
+
+async def test_migrations_include_purchase_receipt():
+    db = Database(":memory:")
+    await db.connect()
+    rows = await migrate_status(db)
+    assert [r["name"] for r in rows][-1] == "purchase-receipts"
+    # Table exists and is writable.
+    await db.execute(
+        "INSERT INTO purchase_receipt (transaction_id, user_id, store,"
+        " receipt, create_time) VALUES ('t1', 'u1', 0, 'blob', 0)"
+    )
+    await db.close()
+
+
+async def test_account_export_gathers_everything():
+    db = Database(":memory:")
+    await db.connect()
+    uid, _, _ = await core_auth.authenticate_device(
+        db, "device-export-01", "exportee", True
+    )
+    from nakama_tpu.core.storage import StorageOpWrite, storage_write_objects
+    from nakama_tpu.core.wallet import Wallets
+
+    await storage_write_objects(
+        db, None,
+        [StorageOpWrite("inv", "sword", uid, '{"dmg": 1}')],
+    )
+    await Wallets(quiet_logger(), db).update_wallets(
+        [{"user_id": uid, "changeset": {"gold": 5}}]
+    )
+    export = await core_account.export_account(db, uid)
+    assert export["account"]["user"]["username"] == "exportee"
+    assert [o["key"] for o in export["objects"]] == ["sword"]
+    assert export["wallet_ledgers"][0]["changeset"] == '{"gold": 5}'
+    assert export["friends"] == [] and export["messages"] == []
+    await db.close()
+
+
+async def test_satori_client_token_and_calls():
+    calls = []
+
+    async def fetch(url, method="GET", headers=None, body=None):
+        calls.append((url, method, headers))
+        return 200, json.dumps({"flags": []}).encode()
+
+    client = SatoriClient(
+        url="https://satori.example",
+        api_key_name="k",
+        api_key="key",
+        signing_key="sign",
+        fetch=fetch,
+    )
+    out = await client.flags_list("user-1", names=["f1"])
+    assert out == {"flags": []}
+    url, method, headers = calls[0]
+    assert url.startswith("https://satori.example/v1/flag?")
+    assert headers["Authorization"].startswith("Bearer ")
+    # Token is a valid HS256 JWT for our signing key.
+    from nakama_tpu.api import session_token as st
+    token = headers["Authorization"][7:]
+    parts = token.split(".")
+    assert len(parts) == 3
+
+    unconfigured = SatoriClient(fetch=fetch)
+    with pytest.raises(SatoriError):
+        await unconfigured.authenticate("u")
