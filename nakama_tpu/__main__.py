@@ -20,20 +20,24 @@ def _migrate(argv: list[str]) -> int:
     db = Database((config.database.address or [":memory:"])[0])
 
     async def run():
+        from .storage.migrations import MIGRATIONS
+
         if sub == "up":
             await db.connect()  # connect applies pending migrations
-            rows = await migrate_status(db)
         elif sub == "status":
-            await db.connect()
-            rows = await migrate_status(db)
+            # Status is read-only: connect WITHOUT applying, then report
+            # pending entries from the embedded migration list.
+            await db.connect(migrate=False)
         else:
             print(f"unknown migrate subcommand: {sub}", file=sys.stderr)
             return 2
-        for row in rows:
-            print(
-                f"{row['version']:>3}  {row['name']:<24} "
-                f"{'applied' if row.get('applied_at') else 'pending'}"
-            )
+        try:
+            applied = {r["version"]: r for r in await migrate_status(db)}
+        except Exception:
+            applied = {}
+        for version, name, _ in MIGRATIONS:
+            state = "applied" if version in applied else "pending"
+            print(f"{version:>3}  {name:<24} {state}")
         await db.close()
         return 0
 

@@ -5,6 +5,15 @@ the JSON shape of the reference's 50-variant Envelope oneof (reference
 nakama-common rtapi/realtime.proto:37-135). MESSAGE_KEYS enumerates the
 client→server and server→client variants; the pipeline validates membership
 before dispatch.
+
+Wire-format decision: the reference negotiates protobuf|json per socket
+(reference socket_ws.go:58-80) because its clients ship generated proto
+stubs. This framework defines its own client contract, and JSON is that
+contract — one canonical encoding end to end (REST and realtime share it),
+no generated-code toolchain, and the hot data path (matchmaker intervals)
+lives on-device where the socket encoding is irrelevant. The `format`
+query parameter survives at the acceptor (api/socket.py) as the seam if a
+binary encoding is ever warranted.
 """
 
 from __future__ import annotations

@@ -607,6 +607,11 @@ class ApiServer:
         try:
             claims = self._session(request)
             body = await self._json(request)
+            body = await self._hooked("readstorageobjects", claims, body)
+            if body is None:
+                raise ApiError(
+                    "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                )
             ops = [
                 StorageOpRead(
                     collection=o.get("collection", ""),
@@ -628,6 +633,11 @@ class ApiServer:
         try:
             claims = self._session(request)
             body = await self._json(request)
+            body = await self._hooked("writestorageobjects", claims, body)
+            if body is None:
+                raise ApiError(
+                    "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                )
             ops = []
             for o in body.get("objects", []):
                 value = o.get("value", "")
@@ -904,7 +914,13 @@ class ApiServer:
     async def _h_friend_add(self, request: web.Request):
         try:
             claims = self._session(request)
-            for fid in await self._resolve_target_ids(request):
+            ids = await self._resolve_target_ids(request)
+            body = await self._hooked("addfriends", claims, {"ids": ids})
+            if body is None:
+                raise ApiError(
+                    "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                )
+            for fid in body.get("ids", []):
                 await self.server.friends.add(
                     claims.user_id, claims.username, fid
                 )
@@ -938,6 +954,11 @@ class ApiServer:
         try:
             claims = self._session(request)
             body = await self._json(request)
+            body = await self._hooked("creategroup", claims, body)
+            if body is None:
+                raise ApiError(
+                    "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                )
             group = await self.server.groups.create(
                 claims.user_id,
                 body.get("name", ""),
@@ -1054,6 +1075,13 @@ class ApiServer:
         try:
             claims = self._session(request)
             body = await self._json(request)
+            body = await self._hooked(
+                "writeleaderboardrecord", claims, body
+            )
+            if body is None:
+                raise ApiError(
+                    "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                )
             record = body.get("record", body)
             result = await self.server.leaderboards.record_write(
                 request.match_info["id"],

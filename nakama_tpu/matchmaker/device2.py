@@ -383,10 +383,31 @@ def _stage2(
 ):
     """Exact re-rank of the per-block winners: [A_pad, B] packed → slots
     [A_pad, k] ordered by (-score, created)."""
+    # Pre-trim the block winners to ~k by packed stage-1 priority BEFORE
+    # any gather: at an 8-pool 160k bench the [A, 256, F] gather of every
+    # pool field was a ~28 GB allocation (OOM on a 16 GB chip). The packed
+    # word sorts by (priority << COL_BITS | col), so top_k keeps the
+    # best-prioritised candidates; the exact re-rank below then orders the
+    # survivors precisely. Keep 2x headroom over k so bucket-granular
+    # false positives rarely crowd out true candidates.
+    keep = min(winners.shape[1], max(2 * k, 8))
+    if winners.shape[1] > keep:
+        winners, _ = jax.lax.top_k(winners, keep)
     cand = winners & (MAX_COLS - 1)  # [A, B]
     alive = winners != PACKED_NONE
 
-    col = {key: v[cand] for key, v in pool_n.items()}  # [A, B, ...]
+    # Gather only what the exact checks read — the candidate's VALUES and
+    # slot metadata always; its QUERY mirrors only under rev (mutual).
+    needed = [
+        "num", "str", "emb", "min_count", "max_count", "party", "pool_id",
+        "flags", "created",
+    ]
+    if rev:
+        needed += [
+            "n_lo", "n_hi", "n_flo", "n_fhi", "s_req", "s_forb",
+            "sh_op", "sh_fld", "sh_lo", "sh_hi", "sh_term", "sh_boost",
+        ]
+    col = {key: pool_n[key][cand] for key in needed}  # [A, B, ...]
 
     # Exact per-field predicate, reusing the small-kernel form: _accepts
     # wants fcol [Bc,...] vs qrow [Br,...]; vmap over rows gives

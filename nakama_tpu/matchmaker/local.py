@@ -94,8 +94,13 @@ class CpuBackend:
         pass
 
     def process(self, actives, pool, *, max_intervals, rev_precision):
+        import operator as _op
+
         matched, expired = process_default(
-            actives,
+            sorted(
+                actives,
+                key=_op.attrgetter("created_at", "created_seq"),
+            ),
             pool,
             max_intervals=max_intervals,
             rev_precision=rev_precision,
@@ -287,13 +292,18 @@ class LocalMatchmaker:
     # -------------------------------------------------------------- process
 
     def process(self):
-        """One matching interval (reference Process, matchmaker.go:282-441)."""
+        """One matching interval (reference Process, matchmaker.go:282-441).
+
+        Actives are handed to the backend UNSORTED; each backend orders
+        the subset it actually walks oldest-first (sorting ~100k actives
+        that a pipelined backend immediately filters as in-flight
+        measured ~0.15s/interval)."""
         t0 = time.perf_counter()
-        actives = sorted(
-            self.active.values(),
-            key=operator.attrgetter("created_at", "created_seq"),
-        )
+        actives = list(self.active.values())
         if self.override_fn is not None:
+            actives.sort(
+                key=operator.attrgetter("created_at", "created_seq")
+            )
             matched, expired = process_custom(
                 actives,
                 self.tickets,

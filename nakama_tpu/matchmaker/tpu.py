@@ -430,6 +430,12 @@ class TpuBackend:
             ]
 
         if device_actives:
+            # Oldest-first fairness for the greedy assembler; sorted here
+            # (not in LocalMatchmaker) so collect-only intervals never pay
+            # a 100k-element sort for rows they won't dispatch.
+            device_actives.sort(
+                key=lambda t: (t.created_at, t.created_seq)
+            )
             slots = np.asarray(
                 [self.pool.slot_of[t.ticket] for t in device_actives],
                 dtype=np.int32,
@@ -489,6 +495,7 @@ class TpuBackend:
         if host_actives:
             # Runs while the device computes and the candidate lists stream
             # back.
+            host_actives.sort(key=lambda t: (t.created_at, t.created_seq))
             host_matched, _ = process_default(
                 host_actives,
                 pool,

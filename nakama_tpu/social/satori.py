@@ -46,7 +46,10 @@ class SatoriClient:
 
     @property
     def configured(self) -> bool:
-        return bool(self.url and self.api_key_name and self.signing_key)
+        return bool(
+            self.url and self.api_key_name
+            and (self.signing_key or self.api_key)
+        )
 
     def _require(self):
         if not self.configured:
@@ -100,10 +103,25 @@ class SatoriClient:
     # ------------------------------------------------------------- surface
 
     async def authenticate(self, identity_id: str) -> dict:
-        return await self._call(
-            "/v1/authenticate", identity_id, method="POST",
-            body={"id": identity_id},
+        """Authenticate presents the API KEY via basic auth (reference
+        satori.go Authenticate); the per-identity JWT covers the rest."""
+        self._require()
+        auth = base64.b64encode(f"{self.api_key}:".encode()).decode()
+        status, data = await self._fetch(
+            self.url + "/v1/authenticate",
+            method="POST",
+            headers={
+                "Authorization": f"Basic {auth}",
+                "Content-Type": "application/json",
+            },
+            body=json.dumps({"id": identity_id}).encode(),
         )
+        if status >= 400:
+            raise SatoriError(f"satori authenticate failed: HTTP {status}")
+        try:
+            return json.loads(data) if data else {}
+        except ValueError as e:
+            raise SatoriError("satori returned invalid JSON") from e
 
     async def events_publish(
         self, identity_id: str, events: list[dict]

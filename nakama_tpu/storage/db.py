@@ -38,7 +38,7 @@ class Database:
 
     # ------------------------------------------------------------ lifecycle
 
-    async def connect(self) -> None:
+    async def connect(self, migrate: bool = True) -> None:
         def _open():
             conn = sqlite3.connect(self.path, check_same_thread=False)
             conn.row_factory = sqlite3.Row
@@ -52,7 +52,8 @@ class Database:
                 max_workers=1, thread_name_prefix="nakama-db"
             )
         self._conn = await self._run(_open)
-        await self.migrate()
+        if migrate:
+            await self.migrate()
 
     async def close(self) -> None:
         # Take the lock so we never close under an open transaction.

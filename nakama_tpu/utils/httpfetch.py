@@ -26,6 +26,8 @@ def _reap_dead_sessions(current_key: int):
                 result = sess.connector.close()
                 if inspect.iscoroutine(result):
                     result.close()  # sync-close path; drop the coroutine
+                # Marks the session closed so its __del__ stays quiet.
+                sess.detach()
             except Exception:
                 pass
 

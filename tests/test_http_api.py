@@ -566,3 +566,48 @@ async def test_friends_and_groups_over_http():
     finally:
         await api.close()
         await server.stop(0)
+
+
+async def test_before_req_hook_gates_storage_write():
+    """Registered before-REQ hooks fire on the REST surface (reference
+    api_*.go hook wrapping)."""
+
+    def init_module(ctx, logger, nk, initializer):
+        def gate(ctx, body):
+            for o in body.get("objects", []):
+                if o.get("collection") == "forbidden":
+                    return None  # reject
+            body.setdefault("objects", [])
+            return body
+
+        initializer.register_before_req("WriteStorageObjects", gate)
+
+    server = await make_server([init_module])
+    api = Api(server)
+    try:
+        _, session = await api.call(
+            "POST",
+            "/v2/account/authenticate/device",
+            headers=basic(),
+            body={"account": {"id": "device-hook-001"}},
+        )
+        token = session["token"]
+        status, _ = await api.call(
+            "PUT",
+            "/v2/storage",
+            headers=bearer(token),
+            body={"objects": [{"collection": "forbidden", "key": "k",
+                               "value": {"a": 1}}]},
+        )
+        assert status == 403
+        status, _ = await api.call(
+            "PUT",
+            "/v2/storage",
+            headers=bearer(token),
+            body={"objects": [{"collection": "ok", "key": "k",
+                               "value": {"a": 1}}]},
+        )
+        assert status == 200
+    finally:
+        await api.close()
+        await server.stop(0)
