@@ -67,6 +67,11 @@ from .process import _mutual, process_default
 from .types import MatchmakerEntry, MatchmakerTicket
 
 
+def _pow2_blocks(blocks: int) -> int:
+    """Smallest power of two >= blocks (>=1)."""
+    return 1 << max(0, blocks - 1).bit_length()
+
+
 def _work_ready(work: tuple) -> bool:
     """Has this dispatched work's device compute + D2H completed?"""
     pending = work[0]
@@ -211,6 +216,8 @@ class TpuBackend:
         # re-dispatched meanwhile (_in_flight).
         self._pipeline_queue: deque = deque()
         self._in_flight: set[str] = set()
+        # Row-bucket shapes already compiled (or prewarmed) this process.
+        self._warmed_buckets: set[tuple] = set()
         # Observed numeric value range per field (bucket grid for the MXU
         # kernel); stale-wide ranges only cost precision, never correctness.
         self._grid_lo = np.full(self.fn, np.inf)
@@ -618,7 +625,15 @@ class TpuBackend:
                 return -(-blocks // 16) * 16
 
             n_cols = min(self.pool.capacity, bucket(-(-hw // bn)) * bn)
-            a_pad = bucket(-(-len(slots) // bm)) * bm
+            # Rows pad pow2-ONLY: active counts swing every interval and
+            # each distinct shape is a multi-second XLA compile that lands
+            # straight in the p99 (measured 3.7-10s spikes from
+            # 48/112-style buckets). The <=2x padded rows are pipelined
+            # MXU time nobody waits on.
+            a_pad = _pow2_blocks(-(-len(slots) // bm)) * bm
+            self._prewarm_row_bucket(
+                a_pad, n_cols, rev, with_should, with_embedding, bm, bn
+            )
 
             width = self._grid_hi - self._grid_lo
             ok = np.isfinite(width) & (width >= 0)
@@ -715,6 +730,56 @@ class TpuBackend:
             with_embedding=with_embedding,
         )
         return ("small", scores, cand)
+
+    def _prewarm_row_bucket(
+        self, a_pad, n_cols, rev, with_should, with_embedding, bm, bn
+    ):
+        """Whenever a row bucket is dispatched, make sure the NEXT-SMALLER
+        bucket is compiled too: active counts decay from the initial
+        full-pool burst toward steady state, and without this the first
+        interval crossing a pow2 boundary eats a multi-second XLA compile
+        right in the p99 (measured 3.7-10s). Checked on EVERY dispatch so
+        the chain propagates (128 warms 64, 64 warms 32, ...). The compile
+        runs on a daemon thread — jit compilation is synchronous on its
+        calling thread but the jit cache is process-wide, so the warm
+        happens off the interval critical path; the dummy execution is a
+        fully-masked half-size pass, a one-off per bucket."""
+        self._warmed_buckets.add((a_pad, n_cols, rev, with_should,
+                                  with_embedding))
+        half = a_pad // 2
+        half_key = (half, n_cols, rev, with_should, with_embedding)
+        if half < bm or half_key in self._warmed_buckets:
+            return
+        self._warmed_buckets.add(half_key)
+        dummy = np.full(half, -1, np.int32)
+        grid_lo = np.zeros(self.fn, np.float32)
+        grid_inv = np.ones(self.fn, np.float32)
+        pool_dev = self.pool.device
+
+        def _warm():
+            try:
+                topk_candidates_big(
+                    pool_dev,
+                    dummy,
+                    grid_lo,
+                    grid_inv,
+                    fn=self.fn,
+                    fs=self.fs,
+                    n_cols=n_cols,
+                    k=self.k,
+                    rev=rev,
+                    with_should=with_should,
+                    with_embedding=with_embedding,
+                    bm=bm,
+                    bn=bn,
+                    interpret=self._interpret,
+                    emb_scale=self.config.emb_score_scale,
+                )
+            except Exception as e:  # best-effort: never break dispatch
+                self._warmed_buckets.discard(half_key)
+                self.logger.debug("bucket prewarm failed", error=str(e))
+
+        threading.Thread(target=_warm, daemon=True).start()
 
     def _collect(self, pending, n_rows: int) -> np.ndarray:
         """Materialize the pending device result into created/score-ordered

@@ -353,3 +353,28 @@ def test_pipelined_dropped_match_reactivates_members():
     # every mode:a ticket must eventually match (t1 with the wildcard or a
     # fresh one; the fresh pair with each other) — nothing stranded
     assert len(mm) <= 1, (len(mm), [t.query for t in mm.tickets.values()])
+
+
+def test_device_pool_rebuild_from_host_extract():
+    """Checkpoint/resume (SURVEY §5): the device pool is reconstructible
+    from the host ticket map at any time — extract() from a live TPU
+    backend, insert() into a FRESH backend (simulating device-state loss
+    or node handover), and the rebuilt pool forms the same matches."""
+    mm1, got1 = make_tpu_mm(max_intervals=4)
+    for i in range(12):
+        mode = f"m{i % 2}"
+        add(mm1, f"+properties.mode:{mode}", strs={"mode": mode})
+    snapshot = mm1.extract()
+    assert len(snapshot) == 12
+
+    # Fresh matchmaker + fresh device backend: nothing survives but the
+    # host-side extract.
+    mm2, got2 = make_tpu_mm(max_intervals=4)
+    mm2.insert(snapshot)
+    assert len(mm2) == 12
+    mm2.process()
+    mm2.process()  # pipelined second pass if enabled (it isn't by default)
+    users = {
+        e.presence.user_id for batch in got2 for match in batch for e in match
+    }
+    assert len(users) == 12  # everyone re-matched on the rebuilt pool
