@@ -157,6 +157,8 @@ class IAPConfig:
     apple_shared_password: str = ""
     google_client_email: str = ""
     google_private_key: str = ""
+    google_package_name: str = ""
+    google_refund_poll_sec: int = 900
     huawei_client_id: str = ""
     huawei_client_secret: str = ""
     huawei_public_key: str = ""

@@ -146,6 +146,30 @@ def _google_service_jwt(client_email: str, private_key_pem: str) -> str:
     return signing + "." + b64u(sig)
 
 
+async def google_access_token(
+    client_email: str, private_key_pem: str, fetch=None
+) -> str:
+    """Service-account JWT grant → androidpublisher access token (shared
+    by receipt validation and the refund scheduler)."""
+    fetch = fetch or _default_fetch
+    grant = _google_service_jwt(client_email, private_key_pem)
+    status, body = await fetch(
+        GOOGLE_TOKEN_URL,
+        method="POST",
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        body=(
+            "grant_type=urn%3Aietf%3Aparams%3Aoauth%3A"
+            f"grant-type%3Ajwt-bearer&assertion={grant}"
+        ).encode(),
+    )
+    if status != 200:
+        raise IAPError(f"google token grant failed: HTTP {status}")
+    access_token = json.loads(body).get("access_token", "")
+    if not access_token:
+        raise IAPError("google token grant returned no access token")
+    return access_token
+
+
 async def validate_receipt_google(
     client_email: str,
     private_key_pem: str,
@@ -168,21 +192,9 @@ async def validate_receipt_google(
     if not (package and product_id and token):
         raise IAPError("google receipt missing fields")
 
-    grant = _google_service_jwt(client_email, private_key_pem)
-    status, body = await fetch(
-        GOOGLE_TOKEN_URL,
-        method="POST",
-        headers={"Content-Type": "application/x-www-form-urlencoded"},
-        body=(
-            "grant_type=urn%3Aietf%3Aparams%3Aoauth%3A"
-            f"grant-type%3Ajwt-bearer&assertion={grant}"
-        ).encode(),
+    access_token = await google_access_token(
+        client_email, private_key_pem, fetch
     )
-    if status != 200:
-        raise IAPError(f"google token grant failed: HTTP {status}")
-    access_token = json.loads(body).get("access_token", "")
-    if not access_token:
-        raise IAPError("google token grant returned no access token")
 
     import urllib.parse as _up
 

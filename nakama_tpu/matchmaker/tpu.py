@@ -621,7 +621,7 @@ class TpuBackend:
                 # pow2 up to 16 blocks, then multiples of 16: bounded
                 # compile-shape count with <= 1.15x padding waste at scale.
                 if blocks <= 16:
-                    return 1 << max(0, blocks - 1).bit_length()
+                    return _pow2_blocks(blocks)
                 return -(-blocks // 16) * 16
 
             n_cols = min(self.pool.capacity, bucket(-(-hw // bn)) * bn)
@@ -678,10 +678,10 @@ class TpuBackend:
 
         # Small-pool exact path (unchanged round-1 kernel).
         n_blocks = -(-len(slots) // self.row_block)
-        a_pad = self.row_block * (1 << max(0, n_blocks - 1).bit_length())
+        a_pad = self.row_block * _pow2_blocks(n_blocks)
         col_blocks = -(-hw // self.col_block)
         n_cols = min(
-            self.col_block * (1 << max(0, col_blocks - 1).bit_length()),
+            self.col_block * _pow2_blocks(col_blocks),
             self.pool.capacity,
         )
         scores, cand = topk_candidates(
@@ -712,7 +712,7 @@ class TpuBackend:
 
         br = self.row_block
         n_blocks = -(-len(slots) // br)
-        a_pad = br * (1 << max(0, n_blocks - 1).bit_length())
+        a_pad = br * _pow2_blocks(n_blocks)
         pad_slots = pad_to(slots, a_pad, -1)
         safe = jnp.asarray(np.maximum(pad_slots, 0))
         rows = dict(self._gather_rows(self.pool.device, safe))

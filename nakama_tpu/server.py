@@ -53,8 +53,7 @@ class NakamaServer:
         self.db = database
         self._owns_db = database is None
         if self.db is None:
-            addr = (config.database.address or [":memory:"])[0]
-            self.db = Database(addr)
+            self.db = Database(config.database.address or [":memory:"])
         self._db_connected = False
         self._runtime_modules = runtime_modules or []
 
@@ -120,8 +119,15 @@ class NakamaServer:
         self.groups = Groups(log, self.db)
 
         from .core.purchase import Purchases
+        from .iap.refund import GoogleRefundScheduler
 
         self.purchases = Purchases(log, self.db, config)
+        self.google_refund_scheduler = GoogleRefundScheduler(
+            log,
+            self.db,
+            config,
+            poll_interval_sec=config.iap.google_refund_poll_sec,
+        )
         self.pipeline = Pipeline(
             log,
             Components(
@@ -243,6 +249,8 @@ class NakamaServer:
             self.runtime.start_events()
         await self.leaderboards.load()
         self.leaderboard_scheduler.start()
+        self.google_refund_scheduler.runtime = self.runtime
+        self.google_refund_scheduler.start()
         self.tracker.start()
         self.matchmaker.start()
         # One port serves the REST API and /ws (reference api.go: the
@@ -273,6 +281,7 @@ class NakamaServer:
         await self.api.stop()
         await self.match_registry.stop_all(grace)
         self.leaderboard_scheduler.stop()
+        self.google_refund_scheduler.stop()
         self.matchmaker.stop()
         for session in self.session_registry.all():
             await session.close("server shutting down")

@@ -24,8 +24,11 @@ class DatabaseError(Exception):
 
 
 class Database:
-    def __init__(self, path: str = ":memory:"):
-        self.path = path
+    def __init__(self, path: str | list[str] = ":memory:"):
+        # Multi-address failover seam (reference DbConnect db.go:35 tries
+        # each DSN in order): the first address that opens wins.
+        self.addresses = [path] if isinstance(path, str) else list(path)
+        self.path = self.addresses[0]
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="nakama-db"
         )
@@ -39,19 +42,34 @@ class Database:
     # ------------------------------------------------------------ lifecycle
 
     async def connect(self, migrate: bool = True) -> None:
-        def _open():
-            conn = sqlite3.connect(self.path, check_same_thread=False)
-            conn.row_factory = sqlite3.Row
-            conn.execute("PRAGMA journal_mode=WAL")
-            conn.execute("PRAGMA foreign_keys=ON")
-            conn.execute("PRAGMA synchronous=NORMAL")
+        def _open(path: str):
+            conn = sqlite3.connect(path, check_same_thread=False)
+            try:
+                conn.row_factory = sqlite3.Row
+                conn.execute("PRAGMA journal_mode=WAL")
+                conn.execute("PRAGMA foreign_keys=ON")
+                conn.execute("PRAGMA synchronous=NORMAL")
+            except sqlite3.Error:
+                conn.close()  # don't leak the handle during failover
+                raise
             return conn
 
         if self._executor._shutdown:  # re-connect after close()
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="nakama-db"
             )
-        self._conn = await self._run(_open)
+        last_error: Exception | None = None
+        for path in self.addresses:
+            try:
+                self._conn = await self._run(_open, path)
+                self.path = path
+                break
+            except sqlite3.Error as e:
+                last_error = e
+        else:
+            raise DatabaseError(
+                f"no database address reachable: {last_error}"
+            )
         if migrate:
             await self.migrate()
 

@@ -79,3 +79,77 @@ async def test_satori_client_token_and_calls():
     unconfigured = SatoriClient(fetch=fetch)
     with pytest.raises(SatoriError):
         await unconfigured.authenticate("u")
+
+
+async def test_db_multi_address_failover():
+    """Reference DbConnect tries each DSN in order (db.go:35)."""
+    db = Database(["/nonexistent-dir/x.db", ":memory:"])
+    await db.connect()
+    assert db.path == ":memory:"
+    assert (await db.fetch_one("SELECT 1 AS one"))["one"] == 1
+    await db.close()
+
+    with pytest.raises(Exception):
+        bad = Database(["/nonexistent-dir/x.db"])
+        await bad.connect()
+
+
+async def test_google_refund_scheduler_marks_and_hooks():
+    """Reference google_refund_scheduler.go:54: voided purchases mark
+    refund_time and fire the purchase notification hook."""
+    import json as _json
+
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    from nakama_tpu.config import Config
+    from nakama_tpu.iap.refund import GoogleRefundScheduler
+    from nakama_tpu.runtime import Initializer, Runtime
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ).decode()
+
+    db = Database(":memory:")
+    await db.connect()
+    await db.execute(
+        "INSERT INTO purchase (user_id, transaction_id, product_id, store,"
+        " raw_response, purchase_time, create_time, update_time)"
+        " VALUES ('u1', 'GPA.void-1', 'gems', 1, '{}', 0, 0, 0)"
+    )
+
+    async def fetch(url, method="GET", headers=None, body=None):
+        if "token" in url:
+            return 200, _json.dumps({"access_token": "at"}).encode()
+        return 200, _json.dumps(
+            {"voidedPurchases": [{"orderId": "GPA.void-1"},
+                                 {"orderId": "GPA.unknown"}]}
+        ).encode()
+
+    config = Config()
+    config.iap.google_client_email = "svc@x.iam"
+    config.iap.google_private_key = pem
+    config.iap.google_package_name = "com.example"
+
+    hooked = []
+    runtime = Runtime(quiet_logger(), config)
+    Initializer(runtime).register_purchase_notification_google(
+        lambda ctx, p: hooked.append(p["transaction_id"])
+    )
+    sched = GoogleRefundScheduler(
+        quiet_logger(), db, config, runtime=runtime, fetch=fetch
+    )
+    assert sched.configured
+    applied = await sched.poll_once()
+    assert applied == 1
+    row = await db.fetch_one(
+        "SELECT refund_time FROM purchase WHERE transaction_id='GPA.void-1'"
+    )
+    assert row["refund_time"] > 0
+    assert hooked == ["GPA.void-1"]
+    # Second sweep is idempotent.
+    assert await sched.poll_once() == 0
+    await db.close()
