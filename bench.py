@@ -21,6 +21,7 @@ Prints ONE JSON line per config; the north-star 100k line is LAST.
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -241,7 +242,12 @@ def measure_device(
                 f"  interval {interval}: {timings[-1]*1000:.1f}ms",
                 file=sys.stderr,
             )
+        # The production cadence gives each interval IntervalSec (15s,
+        # reference config.go:973) of idle gap, where the pipelined device
+        # pass completes and the interval loop runs gc (matchmaker/local
+        # _loop). Model the gap by those completion points, untimed.
         backend.wait_idle()
+        gc.collect()
     mm.stop()
     steady = sorted(timings[warmup:] or timings)
     p99_ms = steady[min(len(steady) - 1, int(len(steady) * 0.99))] * 1000

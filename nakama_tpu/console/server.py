@@ -46,6 +46,13 @@ class ConsoleServer:
         self._started_at = time.time()
 
         r = self.app.router
+        # Prometheus exposition (unauthenticated by scrape-tooling
+        # convention): on the console mux by default, or on its own
+        # internal listener when metrics.prometheus_port is set (the
+        # reference serves scrape on a dedicated port, server/metrics.go).
+        if not self.config.metrics.prometheus_port:
+            r.add_get("/metrics", self._h_metrics)
+        self._metrics_runner = None
         r.add_post("/v2/console/authenticate", self._h_authenticate)
         r.add_get("/v2/console/status", self._h_status)
         r.add_get("/v2/console/config", self._h_config)
@@ -81,9 +88,24 @@ class ConsoleServer:
         self._site = web.TCPSite(self._runner, host, port)
         await self._site.start()
         self.port = self._site._server.sockets[0].getsockname()[1]
+        if self.config.metrics.prometheus_port:
+            metrics_app = web.Application()
+            metrics_app.router.add_get("/metrics", self._h_metrics)
+            self._metrics_runner = web.AppRunner(
+                metrics_app, access_log=None
+            )
+            await self._metrics_runner.setup()
+            await web.TCPSite(
+                self._metrics_runner,
+                host,
+                self.config.metrics.prometheus_port,
+            ).start()
         return self.port
 
     async def stop(self):
+        if self._metrics_runner is not None:
+            await self._metrics_runner.cleanup()
+            self._metrics_runner = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
@@ -155,6 +177,13 @@ class ConsoleServer:
         return role
 
     # -------------------------------------------------------------- status
+
+    async def _h_metrics(self, request: web.Request):
+        return web.Response(
+            body=self.server.metrics.scrape(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
 
     async def _h_status(self, request: web.Request):
         self._auth(request)

@@ -8,12 +8,16 @@ from .client import (
     STORE_HUAWEI,
     IAPError,
     ValidatedPurchase,
+    google_access_token,
     validate_receipt_apple,
     validate_receipt_google,
     validate_receipt_huawei,
 )
+from .refund import GoogleRefundScheduler
 
 __all__ = [
+    "GoogleRefundScheduler",
+    "google_access_token",
     "ENV_PRODUCTION",
     "ENV_SANDBOX",
     "IAPError",

@@ -211,3 +211,16 @@ async def test_console_matchmaker_breadcrumbs():
     finally:
         await console.close()
         await server.stop(0)
+
+
+async def test_prometheus_scrape_endpoint():
+    server = await make_server()
+    console = Console(server)
+    try:
+        async with console.http.get(console.base + "/metrics") as resp:
+            assert resp.status == 200
+            text = await resp.text()
+        assert "nakama_sessions" in text
+    finally:
+        await console.close()
+        await server.stop(0)
