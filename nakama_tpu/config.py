@@ -30,7 +30,9 @@ class LoggerConfig:
 class MetricsConfig:
     reporting_freq_sec: int = 60
     namespace: str = ""
-    prometheus_port: int = 0  # 0 = serve on console mux instead of own port
+    # 0 = exposition disabled (reference semantics); >0 = dedicated
+    # internal listener; -1 = ephemeral port (tests).
+    prometheus_port: int = 0
 
 
 @dataclass

@@ -46,13 +46,8 @@ class ConsoleServer:
         self._started_at = time.time()
 
         r = self.app.router
-        # Prometheus exposition (unauthenticated by scrape-tooling
-        # convention): on the console mux by default, or on its own
-        # internal listener when metrics.prometheus_port is set (the
-        # reference serves scrape on a dedicated port, server/metrics.go).
-        if not self.config.metrics.prometheus_port:
-            r.add_get("/metrics", self._h_metrics)
         self._metrics_runner = None
+        self.metrics_port: int | None = None
         r.add_post("/v2/console/authenticate", self._h_authenticate)
         r.add_get("/v2/console/status", self._h_status)
         r.add_get("/v2/console/config", self._h_config)
@@ -89,17 +84,25 @@ class ConsoleServer:
         await self._site.start()
         self.port = self._site._server.sockets[0].getsockname()[1]
         if self.config.metrics.prometheus_port:
+            # Prometheus exposition on its own internal listener (the
+            # reference serves scrape on a dedicated port and treats 0 as
+            # disabled, server/metrics.go; unauthenticated by
+            # scrape-tooling convention — isolate it by port/firewall).
+            # prometheus_port=-1 binds an ephemeral port (tests).
             metrics_app = web.Application()
             metrics_app.router.add_get("/metrics", self._h_metrics)
             self._metrics_runner = web.AppRunner(
                 metrics_app, access_log=None
             )
             await self._metrics_runner.setup()
-            await web.TCPSite(
-                self._metrics_runner,
-                host,
-                self.config.metrics.prometheus_port,
-            ).start()
+            want = self.config.metrics.prometheus_port
+            metrics_site = web.TCPSite(
+                self._metrics_runner, host, 0 if want < 0 else want
+            )
+            await metrics_site.start()
+            self.metrics_port = (
+                metrics_site._server.sockets[0].getsockname()[1]
+            )
         return self.port
 
     async def stop(self):

@@ -279,7 +279,7 @@ def compile_query(
     if isinstance(node, MatchAll):
         return c
     if not isinstance(node, BooleanQuery):
-        node = BooleanQuery(should=[node])
+        node = BooleanQuery(should=(node,))
 
     c.has_must = bool(node.must)
     c.has_should = bool(node.should)

@@ -187,21 +187,20 @@ class LocalMatchmaker:
             import gc
 
             while not self._stopped:
-                await asyncio.sleep(self.config.interval_sec)
+                # Split the configured interval (cadence stays exactly
+                # interval_sec): a short head-gap after process() lets a
+                # pipelined device pass + D2H clear, then the GC pass
+                # collects the interval's object churn (~2 objects per
+                # matched entry) at a chosen point in the idle gap instead
+                # of a generational pass landing mid-interval (measured
+                # 1-2s pauses at 100k churn).
+                gap = min(2.0, self.config.interval_sec / 4)
+                await asyncio.sleep(gap)
+                gc.collect()
+                await asyncio.sleep(self.config.interval_sec - gap)
                 if not self._paused:
                     try:
                         self.process()
-                        # Collect the interval's object churn (matched
-                        # tickets + entries, ~2 objects/entry) at a chosen
-                        # point in the idle gap instead of letting a
-                        # generational pass land mid-interval (measured
-                        # 1-2s pauses at 100k churn). The short sleep lets
-                        # a pipelined device pass + D2H clear first so the
-                        # bounded collect pause doesn't overlap it.
-                        await asyncio.sleep(
-                            min(2.0, self.config.interval_sec / 4)
-                        )
-                        gc.collect()
                     except Exception as e:  # never kill the interval loop
                         self.logger.error("matchmaker process error", error=str(e))
 

@@ -19,6 +19,7 @@ constraint slots evaluated on device.
 
 from __future__ import annotations
 
+import functools
 import re
 from dataclasses import dataclass, field
 from typing import Any
@@ -94,11 +95,14 @@ class Wildcard:
         return re.compile("".join(rx))
 
 
-@dataclass
+@dataclass(frozen=True)
 class BooleanQuery:
-    must: list = field(default_factory=list)
-    must_not: list = field(default_factory=list)
-    should: list = field(default_factory=list)
+    # Tuples: parse_query results are cached and shared across every
+    # ticket with the same query string, so the AST must be deeply
+    # immutable.
+    must: tuple = ()
+    must_not: tuple = ()
+    should: tuple = ()
     boost: float = 1.0
 
 
@@ -240,26 +244,33 @@ def _parse_clause(tok: str):
     return occur, Term(fld, _unescape(raw), boost)
 
 
+@functools.lru_cache(maxsize=8192)
 def parse_query(q: str) -> Query:
     """Parse a matchmaker query string into an AST.
 
     Reference: ParseQueryString (server/match_common.go:244-251) — ``*``
-    short-circuits to match-all."""
+    short-circuits to match-all. Cached: the AST is frozen dataclasses,
+    and production pools repeat a small set of canonical query strings
+    (mode buckets), so parsing is amortized to a dict hit per add."""
     q = q.strip()
     if q == "" or q == "*":
         return MatchAll()
     clauses = _split_clauses(q)
-    root = BooleanQuery()
+    buckets = {"must": [], "must_not": [], "should": []}
     for tok in clauses:
         if tok == "*":
-            root.should.append(MatchAll())
+            buckets["should"].append(MatchAll())
             continue
         occur, node = _parse_clause(tok)
-        getattr(root, occur).append(node)
-    if not root.must and not root.should:
+        buckets[occur].append(node)
+    if not buckets["must"] and not buckets["should"]:
         # Only must-not clauses: everything not excluded matches.
-        root.should.append(MatchAll())
-    return root
+        buckets["should"].append(MatchAll())
+    return BooleanQuery(
+        must=tuple(buckets["must"]),
+        must_not=tuple(buckets["must_not"]),
+        should=tuple(buckets["should"]),
+    )
 
 
 # ---------------------------------------------------------------- evaluator

@@ -67,6 +67,9 @@ from .process import _mutual, process_default
 from .types import MatchmakerEntry, MatchmakerTicket
 
 
+_CQ_MISS = object()  # cache-miss sentinel (None is a valid cached value)
+
+
 def _pow2_blocks(blocks: int) -> int:
     """Smallest power of two >= blocks (>=1)."""
     return 1 << max(0, blocks - 1).bit_length()
@@ -218,6 +221,8 @@ class TpuBackend:
         self._in_flight: set[str] = set()
         # Row-bucket shapes already compiled (or prewarmed) this process.
         self._warmed_buckets: set[tuple] = set()
+        # query string -> CompiledQuery | None (None = host-only).
+        self._cq_cache: dict[str, CompiledQuery | None] = {}
         # Observed numeric value range per field (bucket grid for the MXU
         # kernel); stale-wide ranges only cost precision, never correctness.
         self._grid_lo = np.full(self.fn, np.inf)
@@ -257,13 +262,33 @@ class TpuBackend:
         host_only = overflow
         cq: CompiledQuery | None = None
         if not host_only:
-            try:
-                cq = compile_query(ticket, self.registry, self.s)
-            except HostOnlyQuery as e:
-                self.logger.debug(
-                    "host-only query", ticket=ticket.ticket, reason=str(e)
-                )
-                host_only = True
+            # Compiled queries are pure functions of (query string,
+            # registry field assignments, constraint budget); the registry
+            # only ever appends, so earlier compiles stay valid. Production
+            # pools repeat a small set of canonical queries — one compile,
+            # then dict hits. CompiledQuery arrays are treated read-only by
+            # every consumer (row staging stacks copies; exact mirrors
+            # assign by slice copy).
+            hit = self._cq_cache.get(ticket.query, _CQ_MISS)
+            if hit is not _CQ_MISS:
+                cq = hit
+                if cq is None:
+                    host_only = True
+            else:
+                try:
+                    cq = compile_query(ticket, self.registry, self.s)
+                except HostOnlyQuery as e:
+                    self.logger.debug(
+                        "host-only query",
+                        ticket=ticket.ticket,
+                        reason=str(e),
+                    )
+                    cq = None
+                if len(self._cq_cache) >= 8192:
+                    self._cq_cache.clear()
+                self._cq_cache[ticket.query] = cq
+                if cq is None:
+                    host_only = True
 
         flags = FLAG_VALID
         if cq is not None:

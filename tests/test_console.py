@@ -214,13 +214,33 @@ async def test_console_matchmaker_breadcrumbs():
 
 
 async def test_prometheus_scrape_endpoint():
-    server = await make_server()
+    # Dedicated internal listener; console mux stays auth-only and the
+    # default (port 0) serves no exposition at all (reference
+    # server/metrics.go semantics).
+    config = Config()
+    config.socket.port = 0
+    config.metrics.prometheus_port = -1  # ephemeral
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
     console = Console(server)
     try:
-        async with console.http.get(console.base + "/metrics") as resp:
+        url = f"http://127.0.0.1:{server.console.metrics_port}/metrics"
+        async with console.http.get(url) as resp:
             assert resp.status == 200
             text = await resp.text()
         assert "nakama_sessions" in text
+        async with console.http.get(console.base + "/metrics") as resp:
+            assert resp.status == 404  # not on the console mux
     finally:
         await console.close()
         await server.stop(0)
+
+    disabled = await make_server()
+    console2 = Console(disabled)
+    try:
+        assert disabled.console.metrics_port is None
+        async with console2.http.get(console2.base + "/metrics") as resp:
+            assert resp.status == 404
+    finally:
+        await console2.close()
+        await disabled.stop(0)
