@@ -131,3 +131,33 @@ def test_big_path_embedding_scoring():
         if any(x.presence.user_id.startswith("eu") for x in entry_set)
     ]
     assert emb_matches == [["eu0", "eu1"]]
+
+
+@pytest.mark.parametrize("rev", [False, True])
+def test_big_path_stress_at_scale(rev):
+    """Larger randomized stress of the two-stage path: parties, count
+    multiples, squads, several intervals with churn, pipelining ON (the
+    production posture), and the stage-2 priority pre-trim engaged. Every
+    formed match must satisfy every member's query/count constraints; the
+    pool must drain meaningfully (no assembler starvation)."""
+    rng = np.random.default_rng(7)
+    specs = _random_pool(rng, 384, party_frac=0.2, multiple=True)
+
+    mm, _ = make_big_mm(
+        max_intervals=3, rev_precision=rev, interval_pipelining=True
+    )
+    matches = []
+    _run(mm, specs, intervals=0)  # adds only
+    mm.on_matched = matches.append
+    for _ in range(6):
+        mm.process()
+        # Model the production interval gap (the bench does the same):
+        # collection only drains COMPLETED device passes.
+        mm.backend.wait_idle()
+    count = _validate_matches(matches, specs, mutual=rev)
+    # With 384 tickets across 3 modes and generous windows, a healthy
+    # matcher forms matches covering a large share of the pool.
+    assert count >= 150, f"only {count} entries matched"
+
+    # The pipelined backend must be drainable (no stuck fetch threads).
+    mm.stop()
