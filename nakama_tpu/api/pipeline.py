@@ -298,6 +298,35 @@ class Pipeline:
         else:
             self._join_relayed(session, cid, match_id)
 
+    def _leave_other_matches(self, session, joining_id: str):
+        """session.single_match: joining a match leaves any previous one
+        (reference SessionConfig SingleMatch). The match being joined is
+        excluded — a self-rejoin must stay an idempotent no-op, not a
+        leave+join that reaches the match loop and other clients."""
+        if not self.c.config.session.single_match:
+            return
+        for stream in list(
+            self.c.tracker.get_local_by_session(session.id)
+        ):
+            if stream.mode in (
+                StreamMode.MATCH_RELAYED, StreamMode.MATCH_AUTHORITATIVE
+            ) and stream.subject != joining_id:
+                self.c.tracker.untrack(session.id, stream)
+
+    def _leave_other_parties(self, session_id: str, joining_id: str):
+        """session.single_party: joining/creating a party leaves any
+        previous one (reference SessionConfig SingleParty). Excludes the
+        party being joined (self-rejoin would otherwise destroy a
+        single-member party / reassign leaders via the async leave)."""
+        if not self.c.config.session.single_party:
+            return
+        for stream in list(self.c.tracker.get_local_by_session(session_id)):
+            if (
+                stream.mode == StreamMode.PARTY
+                and stream.subject != joining_id
+            ):
+                self.c.tracker.untrack(session_id, stream)
+
     async def _join_authoritative(self, session, cid, match_id, metadata):
         registry = _require(self.c.match_registry, "match registry")
         stream = Stream(StreamMode.MATCH_AUTHORITATIVE, subject=match_id)
@@ -305,6 +334,8 @@ class Pipeline:
         allow, reason, handler = await registry.join_attempt(
             match_id, presence, metadata
         )
+        if allow:
+            self._leave_other_matches(session, match_id)
         if not allow:
             session.send(
                 error(
@@ -334,6 +365,7 @@ class Pipeline:
         session.send(out)
 
     def _join_relayed(self, session, cid, match_id):
+        self._leave_other_matches(session, match_id)
         stream = Stream(StreamMode.MATCH_RELAYED, subject=match_id)
         presence = self._presence_for(session, stream)
         existing = [
@@ -427,6 +459,7 @@ class Pipeline:
             )
         except PartyError as e:
             raise PipelineError(str(e)) from e
+        self._leave_other_parties(session.id, handler.party_id)
         presence = self._presence_for(session, handler.stream)
         self.c.tracker.track(
             session.id, handler.stream, session.user_id, presence.meta
@@ -447,6 +480,7 @@ class Pipeline:
         except PartyError as e:
             raise PipelineError(str(e)) from e
         if allowed:
+            self._leave_other_parties(session.id, handler.party_id)
             self.c.tracker.track(
                 session.id, stream, session.user_id, presence.meta
             )
@@ -490,6 +524,9 @@ class Pipeline:
         )
         if target is None:
             raise PipelineError("accepted session gone")
+        self._leave_other_parties(
+            presence.id.session_id, handler.party_id
+        )
         self.c.tracker.track(
             presence.id.session_id,
             handler.stream,

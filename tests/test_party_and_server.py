@@ -279,3 +279,47 @@ async def test_matchmaker_token_joins_relayed_match():
         await b.close()
     finally:
         await server.stop(0)
+
+
+async def test_single_match_and_single_party_enforced():
+    """session.single_match / single_party: joining a new match/party
+    leaves the previous one (reference SessionConfig, config.go)."""
+    config = Config()
+    config.socket.port = 0
+    config.session.single_match = True
+    config.session.single_party = True
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    try:
+        from nakama_tpu.realtime import StreamMode
+
+        alice = await Client.connect(server, "ua", "alice")
+        await alice.send({"cid": "1", "match_create": {}})
+        first = (await alice.recv("match"))["match"]["match_id"]
+        await alice.send({"cid": "2", "match_create": {}})
+        second = (await alice.recv("match"))["match"]["match_id"]
+        assert first != second
+        await asyncio.sleep(0.1)
+        sid = list(server.session_registry.all())[0].id
+        match_streams = [
+            s
+            for s in server.tracker.get_local_by_session(sid)
+            if s.mode
+            in (StreamMode.MATCH_RELAYED, StreamMode.MATCH_AUTHORITATIVE)
+        ]
+        assert [s.subject for s in match_streams] == [second]
+
+        await alice.send({"cid": "3", "party_create": {}})
+        p1 = (await alice.recv("party"))["party"]["party_id"]
+        await alice.send({"cid": "4", "party_create": {}})
+        p2 = (await alice.recv("party"))["party"]["party_id"]
+        await asyncio.sleep(0.1)
+        party_streams = [
+            s
+            for s in server.tracker.get_local_by_session(sid)
+            if s.mode == StreamMode.PARTY
+        ]
+        assert [s.subject for s in party_streams] == [p2]
+        await alice.close()
+    finally:
+        await server.stop(0)
