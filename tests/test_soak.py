@@ -1,0 +1,170 @@
+"""Randomized soak: a swarm of clients doing interleaved realtime ops
+(chat, status, parties, matchmaking, matches, RPC, notifications) against
+one production-wired server. The invariant is structural: the server
+never answers RUNTIME_EXCEPTION/"internal error" (bad input must map to
+structured errors), never logs a pipeline handler crash, and ends with
+consistent registries. The reference has no such tier; SURVEY §4 calls
+for going beyond it."""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+import websockets
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.server import NakamaServer
+
+N_CLIENTS = 12
+OPS_PER_CLIENT = 40
+
+
+def init_module(ctx, logger, nk, initializer):
+    initializer.register_rpc("echo", lambda c, p: p)
+
+
+class Swarm:
+    def __init__(self, server, seed):
+        self.server = server
+        self.rng = random.Random(seed)
+        self.internal_errors: list[dict] = []
+        self.parties: list[str] = []
+        self.matches: list[str] = []
+
+    async def client(self, i):
+        rng = random.Random(i * 7919 + 17)
+        token = self.server.issue_session(f"user-{i}", f"name{i}")
+        ws = await websockets.connect(
+            f"ws://127.0.0.1:{self.server.port}/ws?token={token}"
+        )
+
+        async def drain():
+            try:
+                while True:
+                    raw = await asyncio.wait_for(ws.recv(), 0.01)
+                    e = json.loads(raw)
+                    if "error" in e:
+                        message = e["error"].get("message", "")
+                        if "internal error" in message:
+                            self.internal_errors.append(e)
+                        if self.parties and "party not found" in message:
+                            pass  # raced party close: structured, fine
+            except (asyncio.TimeoutError, Exception):
+                return
+
+        ops = [
+            lambda: {"ping": {}},
+            lambda: {
+                "channel_join": {
+                    "type": 1,
+                    "target": f"room{rng.randrange(3)}",
+                }
+            },
+            lambda: {
+                "channel_message_send": {
+                    "channel_id": f"2...room{rng.randrange(3)}",
+                    "content": {"t": rng.random()},
+                }
+            },
+            lambda: {"status_update": {"status": f"s{rng.random()}"}},
+            lambda: {
+                "status_follow": {
+                    "user_ids": [f"user-{rng.randrange(N_CLIENTS)}"]
+                }
+            },
+            lambda: {
+                "matchmaker_add": {
+                    "min_count": 2,
+                    "max_count": 2,
+                    "query": f"+properties.m:m{rng.randrange(2)}",
+                    "string_properties": {"m": f"m{rng.randrange(2)}"},
+                }
+            },
+            lambda: {"party_create": {"open": True}},
+            lambda: (
+                {
+                    "party_join": {
+                        "party_id": rng.choice(self.parties),
+                    }
+                }
+                if self.parties
+                else {"ping": {}}
+            ),
+            lambda: {"match_create": {}},
+            lambda: (
+                {"match_join": {"match_id": rng.choice(self.matches)}}
+                if self.matches
+                else {"ping": {}}
+            ),
+            lambda: {"rpc": {"id": "echo", "payload": "x"}},
+            # Deliberately malformed inputs MUST map to structured errors.
+            lambda: {"channel_join": {"type": 9, "target": ""}},
+            lambda: {"matchmaker_add": {"min_count": 0, "max_count": 0}},
+            lambda: {"match_data_send": {"match_id": "nope.x", "op_code": 1}},
+            lambda: {"party_join": {"party_id": "missing"}},
+        ]
+        try:
+            for _ in range(OPS_PER_CLIENT):
+                envelope = rng.choice(ops)()
+                envelope["cid"] = str(rng.random())
+                await ws.send(json.dumps(envelope))
+                await drain()
+                # Track created parties/matches for cross-client joins.
+                try:
+                    while True:
+                        raw = await asyncio.wait_for(ws.recv(), 0.005)
+                        e = json.loads(raw)
+                        if "party" in e and "party_id" in e.get("party", {}):
+                            self.parties.append(e["party"]["party_id"])
+                        if "match" in e and "match_id" in e.get("match", {}):
+                            self.matches.append(e["match"]["match_id"])
+                        if "error" in e and "internal error" in e[
+                            "error"
+                        ].get("message", ""):
+                            self.internal_errors.append(e)
+                except asyncio.TimeoutError:
+                    pass
+                if rng.random() < 0.1:
+                    await asyncio.sleep(0)
+        finally:
+            await ws.close()
+
+
+async def test_soak_random_ops():
+    config = Config()
+    config.socket.port = 0
+    config.session.single_party = True
+    errors_logged = []
+    server = NakamaServer(
+        config, quiet_logger(), runtime_modules=[init_module]
+    )
+    # Capture pipeline-crash logs (they indicate unstructured failures).
+    orig_error = server.pipeline.logger.error
+
+    def capture(msg, **kv):
+        errors_logged.append((msg, kv))
+        orig_error(msg, **kv)
+
+    server.pipeline.logger.error = capture
+    await server.start()
+    try:
+        swarm = Swarm(server, seed=1234)
+        await asyncio.gather(
+            *(swarm.client(i) for i in range(N_CLIENTS))
+        )
+        # A couple of matchmaker intervals amid the chaos.
+        server.matchmaker.process()
+        server.matchmaker.process()
+        assert swarm.internal_errors == []
+        crashes = [e for e in errors_logged if e[0] == "pipeline handler error"]
+        assert crashes == [], crashes
+        # Registries drain cleanly when the sessions are gone.
+        await asyncio.sleep(0.2)
+        assert len(server.session_registry.all()) == 0
+        assert server.tracker.count() == 0
+    finally:
+        await server.stop(0)
