@@ -286,3 +286,80 @@ async def test_dm_channel_over_ws():
         await bob.close()
     finally:
         await server.stop(0)
+
+
+async def test_channel_message_update_remove_over_ws():
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    try:
+        alice = await Client.connect(server, "ua", "alice")
+        bob = await Client.connect(server, "ub", "bob")
+        for c in (alice, bob):
+            await c.send(
+                {"cid": "j", "channel_join": {"type": 1, "target": "hall"}}
+            )
+            await c.recv("channel")
+        cid = "2...hall"
+        await alice.send(
+            {
+                "cid": "1",
+                "channel_message_send": {
+                    "channel_id": cid,
+                    "content": {"text": "v1"},
+                },
+            }
+        )
+        ack = (await alice.recv("channel_message_ack"))["channel_message_ack"]
+        mid = ack["message_id"]
+        await bob.recv("channel_message")
+
+        await alice.send(
+            {
+                "cid": "2",
+                "channel_message_update": {
+                    "channel_id": cid,
+                    "message_id": mid,
+                    "content": {"text": "v2"},
+                },
+            }
+        )
+        upd = (await bob.recv("channel_message"))["channel_message"]
+        assert json.loads(upd["content"]) == {"text": "v2"}
+        assert upd["message_id"] == mid
+
+        # Bob cannot remove alice's message (structured error).
+        await bob.send(
+            {
+                "cid": "3",
+                "channel_message_remove": {
+                    "channel_id": cid,
+                    "message_id": mid,
+                },
+            }
+        )
+        err = await bob.recv("error")
+        assert "another user" in err["error"]["message"]
+
+        await alice.send(
+            {
+                "cid": "4",
+                "channel_message_remove": {
+                    "channel_id": cid,
+                    "message_id": mid,
+                },
+            }
+        )
+        # Wait for the REMOVE broadcast (code 2) — earlier acks/broadcasts
+        # may still be queued in the inbox.
+        while True:
+            m = (await bob.recv("channel_message"))["channel_message"]
+            if m.get("code") == 2:
+                break
+        history = await server.channels.messages_list(cid)
+        assert history["messages"] == []
+        await alice.close()
+        await bob.close()
+    finally:
+        await server.stop(0)
