@@ -197,6 +197,18 @@ class LocalMatchmaker:
                 gap = min(2.0, self.config.interval_sec / 4)
                 await asyncio.sleep(gap)
                 gc.collect()
+                # Idle-gap flush: push ticket rows staged so far so the
+                # interval's own flush handles only the adds that arrive
+                # during the remaining sleep (eager 2048-row chunking
+                # already streams the bulk as adds come in).
+                try:
+                    flush = getattr(
+                        getattr(self.backend, "pool", None), "flush", None
+                    )
+                    if flush is not None:
+                        flush()
+                except Exception as e:
+                    self.logger.error("gap flush error", error=str(e))
                 await asyncio.sleep(self.config.interval_sec - gap)
                 if not self._paused:
                     try:
