@@ -36,6 +36,7 @@ class Components:
     party_registry: Any = None
     channels: Any = None  # channel core module facade
     groups: Any = None  # group core (channel-join membership gate)
+    db: Any = None  # username resolution (status follow)
     runtime: Any = None
     session_registry: Any = None
     metrics: Metrics | None = None
@@ -188,9 +189,23 @@ class Pipeline:
 
     # -------------------------------------------------------------- status
 
-    def _h_status_follow(self, session, cid, body):
-        """Reference pipeline_status.go statusFollow."""
-        user_ids = set(body.get("user_ids") or [])
+    async def _h_status_follow(self, session, cid, body):
+        """Reference pipeline_status.go statusFollow: targets may be user
+        ids or usernames (resolved against the accounts table)."""
+        raw_ids = [u for u in (body.get("user_ids") or []) if u]
+        usernames = [u for u in (body.get("usernames") or []) if u]
+        if self.c.db is not None:
+            # Both id and username targets resolve through the users
+            # table; only existing users are followed (reference
+            # statusFollow drops unknown targets, pipeline_status.go).
+            from ..core import account as core_account
+
+            users = await core_account.get_users(
+                self.c.db, user_ids=raw_ids, usernames=usernames
+            )
+            user_ids = {u["id"] for u in users}
+        else:
+            user_ids = set(raw_ids)
         self.c.status_registry.follow(session.id, user_ids)
         presences = []
         for uid in user_ids:

@@ -141,6 +141,7 @@ class NakamaServer:
                 session_registry=self.session_registry,
                 channels=self.channels,
                 groups=self.groups,
+                db=self.db,
                 metrics=self.metrics,
             ),
         )

@@ -192,3 +192,53 @@ async def test_session_registry_disconnect_and_single_session():
     assert await sessions.disconnect("s2")
     assert s2.closed
     assert not await sessions.disconnect("missing")
+
+async def test_status_follow_by_username_over_server():
+    """Reference statusFollow accepts usernames; they resolve through the
+    accounts table (pipeline_status.go)."""
+    import json
+
+    import websockets
+
+    from nakama_tpu.config import Config
+    from nakama_tpu.core import authenticate as core_auth
+    from nakama_tpu.server import NakamaServer
+
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    try:
+        uid, uname, _ = await core_auth.authenticate_device(
+            server.db, "device-status-001", "stalked", True
+        )
+        # The target is online with a status.
+        t_tok = server.issue_session(uid, uname)
+        target = await websockets.connect(
+            f"ws://127.0.0.1:{server.port}/ws?token={t_tok}"
+        )
+        await target.send(
+            json.dumps({"cid": "s", "status_update": {"status": "AFK"}})
+        )
+        await asyncio.sleep(0.1)
+
+        w_tok = server.issue_session("watcher", "watcher")
+        watcher = await websockets.connect(
+            f"ws://127.0.0.1:{server.port}/ws?token={w_tok}"
+        )
+        await watcher.send(
+            json.dumps(
+                {"cid": "f", "status_follow": {"usernames": ["stalked"]}}
+            )
+        )
+        while True:
+            e = json.loads(await asyncio.wait_for(watcher.recv(), 5))
+            if "status" in e:
+                break
+        presences = e["status"]["presences"]
+        assert [p["status"] for p in presences] == ["AFK"]
+        assert presences[0]["user_id"] == uid
+        await target.close()
+        await watcher.close()
+    finally:
+        await server.stop(0)
