@@ -125,16 +125,14 @@ class ApiServer:
                 f"/v2/account/authenticate/{provider}",
                 self._make_authenticate(provider),
             )
-            if provider != "facebookinstantgame":
-                link_name = provider
-                r.add_post(
-                    f"/v2/account/link/{link_name}",
-                    self._make_link(link_name, linking=True),
-                )
-                r.add_post(
-                    f"/v2/account/unlink/{link_name}",
-                    self._make_link(link_name, linking=False),
-                )
+            r.add_post(
+                f"/v2/account/link/{provider}",
+                self._make_link(provider, linking=True),
+            )
+            r.add_post(
+                f"/v2/account/unlink/{provider}",
+                self._make_link(provider, linking=False),
+            )
         r.add_post("/v2/account/session/refresh", self._h_session_refresh)
         r.add_post("/v2/session/logout", self._h_session_logout)
         r.add_get("/v2/account", self._h_account_get)
@@ -407,18 +405,8 @@ class ApiServer:
                         )
                     )
                 else:
-                    social = self.server.social
-                    if social is None:
-                        raise ApiError(
-                            f"{provider} authentication not configured",
-                            501,
-                            GRPC_UNIMPLEMENTED,
-                        )
-                    fn = getattr(core_auth, f"authenticate_{provider}", None)
-                    if provider == "facebookinstantgame":
-                        fn = core_auth.authenticate_facebook_instant
-                    user_id, uname, created = await fn(
-                        db, social, account, username, create
+                    user_id, uname, created = await self._social_auth(
+                        provider, account, username, create
                     )
                 result = {
                     "created": created,
@@ -432,6 +420,66 @@ class ApiServer:
                 return self._map_error(e)
 
         return handler
+
+    async def _social_auth(self, provider, account, username, create):
+        """Per-provider dispatch into the social authenticate cores
+        (each has its own credential shape — reference api_authenticate.go
+        handlers)."""
+        social = self.server.social
+        if social is None:
+            raise ApiError(
+                f"{provider} authentication not configured",
+                501,
+                GRPC_UNIMPLEMENTED,
+            )
+        db = self.server.db
+        sc = self.config.social
+        token = account.get("token", "")
+        if provider == "facebook":
+            return await core_auth.authenticate_facebook(
+                db, social, token, username, create
+            )
+        if provider == "facebookinstantgame":
+            return await core_auth.authenticate_facebook_instant(
+                db,
+                social,
+                sc.facebook_instant_app_secret,
+                account.get("signed_player_info", ""),
+                username,
+                create,
+            )
+        if provider == "google":
+            return await core_auth.authenticate_google(
+                db, social, token, username, create
+            )
+        if provider == "apple":
+            return await core_auth.authenticate_apple(
+                db, social, sc.apple_bundle_id, token, username, create
+            )
+        if provider == "steam":
+            return await core_auth.authenticate_steam(
+                db,
+                social,
+                sc.steam_app_id,
+                sc.steam_publisher_key,
+                token,
+                username,
+                create,
+            )
+        if provider == "gamecenter":
+            return await core_auth.authenticate_gamecenter(
+                db,
+                social,
+                account.get("player_id", ""),
+                account.get("bundle_id", ""),
+                int(account.get("timestamp_seconds", 0)),
+                account.get("salt", ""),
+                account.get("signature", ""),
+                account.get("public_key_url", ""),
+                username,
+                create,
+            )
+        raise ApiError("unknown provider", 400, GRPC_INVALID_ARGUMENT)
 
     async def _h_session_refresh(self, request: web.Request):
         try:
@@ -578,28 +626,75 @@ class ApiServer:
                         await core_link.link_custom(db, uid, body.get("id", ""))
                     else:
                         await core_link.unlink_custom(db, uid)
-                else:
-                    social = self.server.social
-                    fn = getattr(
-                        core_link,
-                        f"{'link' if linking else 'unlink'}_{provider}",
-                        None,
+                elif not linking:
+                    core_name = (
+                        "facebook_instant"
+                        if provider == "facebookinstantgame"
+                        else provider
                     )
-                    if fn is None or social is None:
+                    fn = getattr(core_link, f"unlink_{core_name}", None)
+                    if fn is None:
                         raise ApiError(
-                            f"{provider} linking not configured",
+                            f"{provider} unlink not available",
                             501,
                             GRPC_UNIMPLEMENTED,
                         )
-                    if linking:
-                        await fn(db, uid, social, body)
-                    else:
-                        await fn(db, uid)
+                    await fn(db, uid)
+                else:
+                    await self._social_link(provider, uid, body)
                 return web.json_response({})
             except Exception as e:
                 return self._map_error(e)
 
         return handler
+
+    async def _social_link(self, provider: str, uid: str, body: dict):
+        """Per-provider social link dispatch (reference api_link.go)."""
+        social = self.server.social
+        if social is None:
+            raise ApiError(
+                f"{provider} linking not configured", 501, GRPC_UNIMPLEMENTED
+            )
+        db = self.server.db
+        sc = self.config.social
+        token = body.get("token", "")
+        if provider == "facebook":
+            await core_link.link_facebook(db, social, uid, token)
+        elif provider == "facebookinstantgame":
+            await core_link.link_facebook_instant(
+                db,
+                social,
+                uid,
+                sc.facebook_instant_app_secret,
+                body.get("signed_player_info", ""),
+            )
+        elif provider == "google":
+            await core_link.link_google(db, social, uid, token)
+        elif provider == "apple":
+            await core_link.link_apple(
+                db, social, uid, sc.apple_bundle_id, token
+            )
+        elif provider == "steam":
+            await core_link.link_steam(
+                db, social, uid, sc.steam_app_id, sc.steam_publisher_key,
+                token,
+            )
+        elif provider == "gamecenter":
+            await core_link.link_gamecenter(
+                db,
+                social,
+                uid,
+                body.get("player_id", ""),
+                body.get("bundle_id", ""),
+                int(body.get("timestamp_seconds", 0)),
+                body.get("salt", ""),
+                body.get("signature", ""),
+                body.get("public_key_url", ""),
+            )
+        else:
+            raise ApiError(
+                f"{provider} linking not available", 501, GRPC_UNIMPLEMENTED
+            )
 
     # ------------------------------------------------------------ storage
 
@@ -1252,6 +1347,12 @@ class ApiServer:
 
         if isinstance(e, ApiError):
             return _error_response(str(e), e.status, e.grpc_code)
+        from ..social.client import SocialError
+
+        if isinstance(e, SocialError):
+            # Failed provider verification = unauthenticated (the auth
+            # path maps it via core_auth._verify; link paths raise raw).
+            return _error_response(str(e), 401, GRPC_UNAUTHENTICATED)
         if isinstance(
             e,
             (AuthError, ChannelError, FriendError, GroupError,

@@ -156,7 +156,12 @@ class NakamaServer:
             self.metrics,
             matchmaker=self.matchmaker,
         )
-        self.social = None  # social.Client attached when configured
+        # Production social verifier (reference social.NewClient,
+        # main.go:136); per-provider config rides each call. Tests may
+        # substitute a StubSocialClient.
+        from .social.client import HttpSocialClient
+
+        self.social = HttpSocialClient()
 
         from .leaderboard import (
             LeaderboardRankCache,

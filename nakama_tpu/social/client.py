@@ -49,6 +49,10 @@ class SocialClient:
         """Signed-payload check, no network needed (reference
         social.go:310-368): payload is `sig.b64(json)` where sig =
         HMAC-SHA256(app_secret, payload-part)."""
+        if not app_secret:
+            # An empty secret would make the HMAC forgeable by anyone —
+            # unconfigured must mean unavailable, not open.
+            raise SocialError("facebook instant app secret not configured")
         try:
             sig_part, payload_part = signed_player_info.split(".", 1)
             expected = base64.urlsafe_b64decode(

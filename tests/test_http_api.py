@@ -611,3 +611,127 @@ async def test_before_req_hook_gates_storage_write():
     finally:
         await api.close()
         await server.stop(0)
+
+
+async def test_social_authenticate_and_link_over_http():
+    """Social flows end-to-end with a stub verifier: facebookinstantgame
+    verifies the HMAC payload offline; facebook auth + link use the stub
+    registry (the HttpSocialClient crypto itself is covered in
+    test_social_verify.py)."""
+    import base64 as b64
+    import hashlib
+    import hmac as hmac_mod
+
+    from nakama_tpu.social.client import SocialProfile, StubSocialClient
+
+    server = await make_server()
+    stub = StubSocialClient()
+    stub.register(
+        "facebook", "fbtok-1", SocialProfile(provider="facebook", id="fb-77")
+    )
+    server.social = stub
+    server.config.social.facebook_instant_app_secret = "secret1"
+    api = Api(server)
+    try:
+        # Facebook auth creates an account bound to the social id.
+        status, session = await api.call(
+            "POST",
+            "/v2/account/authenticate/facebook",
+            headers=basic(),
+            body={"account": {"token": "fbtok-1"}},
+        )
+        assert status == 200 and session["created"] is True
+        status, again = await api.call(
+            "POST",
+            "/v2/account/authenticate/facebook",
+            headers=basic(),
+            body={"account": {"token": "fbtok-1"}},
+        )
+        assert status == 200 and again["created"] is False
+        status, _ = await api.call(
+            "POST",
+            "/v2/account/authenticate/facebook",
+            headers=basic(),
+            body={"account": {"token": "wrong"}},
+        )
+        assert status == 401
+
+        # FB Instant: real HMAC check, no network.
+        payload = b64.urlsafe_b64encode(b'{"player_id": "pi-9"}').decode()
+        sig = b64.urlsafe_b64encode(
+            hmac_mod.new(
+                b"secret1", payload.encode(), hashlib.sha256
+            ).digest()
+        ).decode()
+        status, s2 = await api.call(
+            "POST",
+            "/v2/account/authenticate/facebookinstantgame",
+            headers=basic(),
+            body={"account": {"signed_player_info": f"{sig}.{payload}"}},
+        )
+        assert status == 200 and s2["created"] is True
+
+        # Link google to the fb-instant account via the stub.
+        stub.register(
+            "google", "gtok-5", SocialProfile(provider="google", id="g-5")
+        )
+        status, _ = await api.call(
+            "POST",
+            "/v2/account/link/google",
+            headers=bearer(s2["token"]),
+            body={"token": "gtok-5"},
+        )
+        assert status == 200
+        row = await server.db.fetch_one(
+            "SELECT google_id FROM users WHERE google_id = 'g-5'"
+        )
+        assert row is not None
+        status, _ = await api.call(
+            "POST",
+            "/v2/account/unlink/google",
+            headers=bearer(s2["token"]),
+        )
+        assert status == 200
+
+        # Bad link token maps to 401 (not a 500).
+        status, _ = await api.call(
+            "POST",
+            "/v2/account/link/google",
+            headers=bearer(s2["token"]),
+            body={"token": "bogus"},
+        )
+        assert status == 401
+
+        # FB Instant unlink exists (account keeps google? no — google was
+        # unlinked; link an email first so the last-method guard passes).
+        status, _ = await api.call(
+            "POST",
+            "/v2/account/link/email",
+            headers=bearer(s2["token"]),
+            body={"email": "fbi@example.com", "password": "longpassword1"},
+        )
+        assert status == 200
+        status, _ = await api.call(
+            "POST",
+            "/v2/account/unlink/facebookinstantgame",
+            headers=bearer(s2["token"]),
+        )
+        assert status == 200
+
+        # Unconfigured FB Instant secret must refuse, never verify.
+        server.config.social.facebook_instant_app_secret = ""
+        import hashlib as _h, hmac as _hm, base64 as _b
+        p2 = _b.urlsafe_b64encode(b'{"player_id": "forged"}').decode()
+        s_forged = _b.urlsafe_b64encode(
+            _hm.new(b"", p2.encode(), _h.sha256).digest()
+        ).decode()
+        status, _ = await api.call(
+            "POST",
+            "/v2/account/authenticate/facebookinstantgame",
+            headers=basic(),
+            body={"account": {"signed_player_info": f"{s_forged}.{p2}"}},
+        )
+        assert status == 401
+    finally:
+        await api.close()
+        await server.stop(0)
