@@ -223,8 +223,8 @@ def measure_device(
         test_logger(),
         cfg,
         backend=backend,
-        on_matched=lambda sets: matched_total.__setitem__(
-            0, matched_total[0] + sum(len(s) for s in sets)
+        on_matched=lambda batch: matched_total.__setitem__(
+            0, matched_total[0] + batch.entry_count
         ),
     )
     fill(mm, rng, pool, "w", make_ticket)
@@ -251,6 +251,7 @@ def measure_device(
         # pass completes and the interval loop runs gc (matchmaker/local
         # _loop). Model the gap by those completion points, untimed.
         backend.wait_idle()
+        mm.store.drain()
         gc.collect()
     mm.stop()
     steady = sorted(timings[warmup:] or timings)

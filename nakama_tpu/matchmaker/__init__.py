@@ -27,6 +27,7 @@ from .local import (
 )
 from .query import QueryError, evaluate, matches, parse_query
 from .types import (
+    MatchBatch,
     MatchmakerEntry,
     MatchmakerExtract,
     MatchmakerPresence,
@@ -45,6 +46,7 @@ __all__ = [
     "parse_query",
     "evaluate",
     "matches",
+    "MatchBatch",
     "MatchmakerEntry",
     "MatchmakerExtract",
     "MatchmakerPresence",

@@ -94,6 +94,29 @@ def _invalidate(pool: dict, idx: jnp.ndarray) -> dict:
     return out
 
 
+class _SlotOfView:
+    """Read-only mapping view of ticket id -> slot (PoolBuffer compat)."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def __getitem__(self, ticket_id: str) -> int:
+        slot = self._store.slot_by_id(ticket_id)
+        if slot is None:
+            raise KeyError(ticket_id)
+        return slot
+
+    def get(self, ticket_id: str, default=None):
+        slot = self._store.slot_by_id(ticket_id)
+        return default if slot is None else slot
+
+    def __contains__(self, ticket_id: str) -> bool:
+        return self._store.slot_by_id(ticket_id) is not None
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+
 class PoolBuffer:
     """Slot-allocated, device-resident ticket pool with queued updates.
 
@@ -143,69 +166,77 @@ class PoolBuffer:
             self.device = jax.tree.map(jnp.asarray, host)
             self._scatter = _scatter
             self._invalidate = _invalidate
-        # LIFO free list popping slot 0 first: the pool stays dense at the
-        # low end, so the kernel can stop at the high-water mark.
-        self._free = list(range(capacity - 1, -1, -1))
+        # Slot allocation lives in the caller's SlotStore (store.py) so
+        # host metadata, reverse maps, and device rows share one slot
+        # space; this buffer only stages device-row updates by slot.
         self.high_water = 0
-        # slot -> row (add/update) or None (removal). Insertion-order dict:
-        # assignment gives last-op-wins dedupe for free.
-        self._pending: dict[int, dict[str, np.ndarray] | None] = {}
-        self.slot_of: dict[str, int] = {}  # ticket id -> slot
+        # slot -> staged row. Removals batch as raw slot ARRAYS — the
+        # matched-churn path hands us ~100k slots/interval and per-slot
+        # Python was the round-2 floor. Adds after removal of the same
+        # slot are resolved by flush order (invalidate first, then
+        # scatter); removal of a just-staged add pops the staged row via
+        # the pending-add mask (rare, vectorized membership test).
+        self._pending_add: dict[int, dict[str, np.ndarray]] = {}
+        self._pending_add_mask = np.zeros(capacity, dtype=bool)
+        self._pending_rm: list[np.ndarray] = []
+        self._pending_rm_n = 0
+        self.store = None  # SlotStore, bound by the backend at attach
 
     def __len__(self) -> int:
-        return len(self.slot_of)
+        return len(self.store) if self.store is not None else 0
 
-    def add(self, ticket_id: str, row: dict[str, np.ndarray]) -> int:
-        if not self._free:
-            raise RuntimeError("matchmaker pool capacity exceeded")
-        slot = self._free.pop()
-        self.slot_of[ticket_id] = slot
+    @property
+    def slot_of(self):
+        """Compat mapping view: ticket id -> slot via the SlotStore."""
+        return _SlotOfView(self.store)
+
+    def add(self, slot: int, row: dict[str, np.ndarray]):
         self.high_water = max(self.high_water, slot + 1)
-        self._pending[slot] = row
-        if len(self._pending) >= self.flush_chunk:
+        self._pending_add[slot] = row
+        self._pending_add_mask[slot] = True
+        if len(self._pending_add) >= self.flush_chunk:
             self.flush()
-        return slot
 
-    def remove(self, ticket_id: str):
-        slot = self.slot_of.pop(ticket_id, None)
-        if slot is None:
+    def remove_slots(self, slots: np.ndarray):
+        """Bulk removal by slot array — O(1) Python ops per call."""
+        if len(slots) == 0:
             return
-        self._free.append(slot)
-        self._pending[slot] = None
-
-    def remove_many(self, ticket_ids: list[str]) -> list[int]:
-        """Bulk removal; returns the freed slots. One flush check at the
-        end instead of per ticket (interval churn is ~100k tickets at the
-        bench pool)."""
-        slot_of = self.slot_of
-        free = self._free
-        pending = self._pending
-        gone: list[int] = []
-        for tid in ticket_ids:
-            slot = slot_of.pop(tid, None)
-            if slot is None:
-                continue
-            free.append(slot)
-            pending[slot] = None
-            gone.append(slot)
-        if len(pending) >= self.flush_chunk:
+        slots = np.asarray(slots, dtype=np.int32)
+        staged = slots[self._pending_add_mask[slots]]
+        for s in staged:  # rare: removed before its add ever flushed
+            self._pending_add.pop(int(s), None)
+        if len(staged):
+            self._pending_add_mask[staged] = False
+        self._pending_rm.append(slots)
+        self._pending_rm_n += len(slots)
+        if self._pending_rm_n >= self.flush_chunk:
             self.flush()
-        return gone
 
     def flush(self):
         """Apply queued updates: one flags-invalidate scatter for removals
-        (4B/slot) + one row scatter for adds.
+        (4B/slot) + one row scatter for adds, removals first so a freed
+        slot re-added in the same window ends up live.
 
         Counts are padded to a power of two (repeating the last entry — an
         idempotent duplicate write) so XLA compiles one scatter per size
         bucket instead of one per distinct update count."""
-        if not self._pending:
+        if not self._pending_add and not self._pending_rm:
             return
-        rm_idx = [s for s, row in self._pending.items() if row is None]
-        add_items = [
-            (s, row) for s, row in self._pending.items() if row is not None
-        ]
-        self._pending = {}
+        rm_idx = (
+            np.concatenate(self._pending_rm).tolist()
+            if self._pending_rm
+            else []
+        )
+        add_items = list(self._pending_add.items())
+        if add_items:
+            self._pending_add_mask[
+                np.fromiter(
+                    self._pending_add.keys(), np.int64, len(add_items)
+                )
+            ] = False
+        self._pending_add = {}
+        self._pending_rm = []
+        self._pending_rm_n = 0
 
         # Everything at or under one chunk pads to exactly the chunk size:
         # ONE compiled scatter shape covers the steady state (pow2 buckets

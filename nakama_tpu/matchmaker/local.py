@@ -5,6 +5,13 @@ Capability parity with the reference Matchmaker interface and LocalMatchmaker
 per-session and per-party MaxTickets enforcement, pause/resume/stop, and a
 per-interval `process()` that forms matches and reports them to a callback.
 
+Host bookkeeping is slot-centric (store.py): ticket state lives in
+numpy arrays + native hash maps indexed by pool slot, so the interval
+path — interval bumping, expiry, matched-ticket unregistration, match
+delivery — is O(batch) numpy/native calls, never per-entry Python (the
+round-2 latency floor). Delivery hands `on_matched` a columnar
+`MatchBatch`; consumers that need entry objects materialize them lazily.
+
 The process backend is pluggable: the CPU oracle (`process.py`) or the TPU
 batch backend (`tpu.py`). Custom (runtime-override) processing always runs
 the host path since it enumerates combinatorial candidates for user code.
@@ -17,17 +24,20 @@ NewLocalBenchMatchmaker (server/matchmaker_test.go:1697).
 from __future__ import annotations
 
 import asyncio
-import operator
 import time
 import uuid
 from typing import Callable, Protocol
+
+import numpy as np
 
 from ..config import MatchmakerConfig
 from ..logger import Logger
 from ..metrics import Metrics
 from .process import process_custom, process_default
 from .query import QueryError, parse_query
+from .store import SlotStore
 from .types import (
+    MatchBatch,
     MatchmakerEntry,
     MatchmakerExtract,
     MatchmakerPresence,
@@ -55,57 +65,85 @@ class ErrNotAvailable(MatchmakerError):
     pass
 
 
-MatchedCallback = Callable[[list[list[MatchmakerEntry]]], None]
+MatchedCallback = Callable[[MatchBatch], None]
 OverrideFn = Callable[
     [list[list[MatchmakerEntry]]], list[list[MatchmakerEntry]]
 ]
 
 
 class ProcessBackend(Protocol):
-    def on_add(self, ticket: MatchmakerTicket) -> None:
-        """Called before a ticket enters the pool; may raise to reject it."""
+    def attach(self, store: SlotStore) -> None:
+        """Bind the shared slot store before any other call."""
 
-    def on_remove(self, ticket_id: str) -> None:
-        """Called when a ticket leaves the pool."""
+    def on_add(self, ticket: MatchmakerTicket, slot: int) -> None:
+        """Called after the ticket is slot-registered; may raise to reject
+        it (the caller rolls the registration back)."""
 
-    def process(
+    def on_remove_slots(self, slots: np.ndarray) -> None:
+        """Called when tickets leave the pool, BEFORE the store clears
+        their slots."""
+
+    def process_slots(
         self,
-        actives: list[MatchmakerTicket],
-        pool: dict[str, MatchmakerTicket],
+        active_slots: np.ndarray,
+        last_interval: np.ndarray,
         *,
         max_intervals: int,
         rev_precision: bool,
-    ) -> tuple[list[list[MatchmakerEntry]], list[str], set[str]]:
-        """Returns (matched entry sets, expired ticket ids, reactivate ids).
+    ) -> tuple[MatchBatch, np.ndarray, np.ndarray]:
+        """Returns (batch, matched_slots, reactivate_slots).
 
-        `reactivate` covers tickets whose pipelined match was invalidated
-        after they already went inactive — they get another active interval
-        so churn can't strand them passively matchable forever."""
+        `reactivate_slots` covers tickets whose pipelined match was
+        invalidated after they already went inactive — they get another
+        active interval so churn can't strand them passively matchable
+        forever."""
         ...
 
 
 class CpuBackend:
-    """The oracle backend — exact reference semantics on host."""
+    """The oracle backend — exact reference semantics on host objects."""
 
-    def on_add(self, ticket: MatchmakerTicket) -> None:
+    def __init__(self):
+        self.store: SlotStore | None = None
+
+    def attach(self, store: SlotStore):
+        self.store = store
+
+    def on_add(self, ticket: MatchmakerTicket, slot: int) -> None:
         pass
 
-    def on_remove(self, ticket_id: str) -> None:
+    def on_remove_slots(self, slots: np.ndarray) -> None:
         pass
 
-    def process(self, actives, pool, *, max_intervals, rev_precision):
-        import operator as _op
-
-        matched, expired = process_default(
-            sorted(
-                actives,
-                key=_op.attrgetter("created_at", "created_seq"),
-            ),
+    def process_slots(
+        self, active_slots, last_interval, *, max_intervals, rev_precision
+    ):
+        store = self.store
+        actives, _, pool = store.oracle_view(active_slots)
+        matched, _ = process_default(
+            actives,
             pool,
             max_intervals=max_intervals,
             rev_precision=rev_precision,
+            bump_intervals=False,
         )
-        return matched, expired, set()
+        batch, slots = lists_to_batch(matched, store)
+        return batch, slots, np.zeros(0, dtype=np.int32)
+
+
+def lists_to_batch(
+    matched: list[list[MatchmakerEntry]], store: SlotStore
+) -> tuple[MatchBatch, np.ndarray]:
+    """Wrap object-path match lists (oracle / override) as a MatchBatch +
+    the flat matched slot array for bulk removal."""
+    batch = MatchBatch.from_lists(matched)
+    slot_parts: list[int] = []
+    for entry_set in matched:
+        for tid in dict.fromkeys(e.ticket for e in entry_set):
+            slot = store.slot_by_id(tid)
+            if slot is not None:
+                slot_parts.append(slot)
+    return batch, np.asarray(slot_parts, dtype=np.int32)
 
 
 def _select_backend(config: MatchmakerConfig, logger, metrics):
@@ -133,6 +171,66 @@ def _select_backend(config: MatchmakerConfig, logger, metrics):
     return TpuBackend(config, logger, metrics)
 
 
+class _TicketsView:
+    """Mapping-compat view of live tickets (tests/console); not used on
+    the interval path."""
+
+    def __init__(self, store: SlotStore):
+        self._store = store
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def __contains__(self, ticket_id: str) -> bool:
+        return ticket_id in self._store
+
+    def __getitem__(self, ticket_id: str) -> MatchmakerTicket:
+        t = self._store.get(ticket_id)
+        if t is None:
+            raise KeyError(ticket_id)
+        return t
+
+    def get(self, ticket_id: str, default=None):
+        t = self._store.get(ticket_id)
+        return default if t is None else t
+
+    def __iter__(self):
+        for t in self._store.live_tickets():
+            yield t.ticket
+
+    def keys(self):
+        return iter(self)
+
+    def values(self):
+        return self._store.live_tickets()
+
+    def items(self):
+        return [(t.ticket, t) for t in self._store.live_tickets()]
+
+
+class _ActiveView:
+    """Mapping-compat view of active tickets (tests/console)."""
+
+    def __init__(self, store: SlotStore):
+        self._store = store
+
+    def __len__(self) -> int:
+        return self._store.n_active
+
+    def __contains__(self, ticket_id: str) -> bool:
+        slot = self._store.slot_by_id(ticket_id)
+        return slot is not None and bool(self._store.active[slot])
+
+    def values(self):
+        return list(self._store.ticket_at[self._store.active])
+
+    def keys(self):
+        return [t.ticket for t in self.values()]
+
+    def __iter__(self):
+        return iter(self.keys())
+
+
 class LocalMatchmaker:
     def __init__(
         self,
@@ -147,18 +245,25 @@ class LocalMatchmaker:
         self.config = config
         self.metrics = metrics
         self.node = node
+        self.store = SlotStore(config.pool_capacity, config.max_party_size)
         self.backend = backend or _select_backend(config, self.logger, metrics)
+        self.backend.attach(self.store)
         self.on_matched = on_matched
         self.override_fn: OverrideFn | None = None
-
-        self.tickets: dict[str, MatchmakerTicket] = {}  # insertion-ordered
-        self.active: dict[str, MatchmakerTicket] = {}
-        self.session_tickets: dict[str, set[str]] = {}
-        self.party_tickets: dict[str, set[str]] = {}
 
         self._paused = False
         self._stopped = False
         self._task: asyncio.Task | None = None
+
+    # ------------------------------------------------------ compat views
+
+    @property
+    def tickets(self) -> _TicketsView:
+        return _TicketsView(self.store)
+
+    @property
+    def active(self) -> _ActiveView:
+        return _ActiveView(self.store)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -193,9 +298,12 @@ class LocalMatchmaker:
                 # collects the interval's object churn (~2 objects per
                 # matched entry) at a chosen point in the idle gap instead
                 # of a generational pass landing mid-interval (measured
-                # 1-2s pauses at 100k churn).
+                # 1-2s pauses at 100k churn). The store graveyard (matched
+                # ticket objects parked at removal) drains here too, so
+                # the refcount cascade of ~100k objects is idle-gap work.
                 gap = min(2.0, self.config.interval_sec / 4)
                 await asyncio.sleep(gap)
+                self.store.drain()
                 gc.collect()
                 # Idle-gap flush: push ticket rows staged so far so the
                 # interval's own flush handles only the adds that arrive
@@ -259,9 +367,12 @@ class LocalMatchmaker:
 
         max_tickets = self.config.max_tickets
         for p in presences:
-            if len(self.session_tickets.get(p.session_id, ())) >= max_tickets:
+            if self.store.session_ticket_count(p.session_id) >= max_tickets:
                 raise ErrTooManyTickets(p.session_id)
-        if party_id and len(self.party_tickets.get(party_id, ())) >= max_tickets:
+        if (
+            party_id
+            and self.store.party_ticket_count(party_id) >= max_tickets
+        ):
             raise ErrTooManyTickets(party_id)
 
         ticket_id = str(uuid.uuid4())
@@ -298,178 +409,180 @@ class LocalMatchmaker:
         return ticket_id, created_at
 
     def _register(self, ticket: MatchmakerTicket, active: bool = True):
-        # Backend first: a rejection (pool capacity, party size) must leave
-        # the local maps untouched or every later interval breaks on the
-        # orphaned ticket.
-        self.backend.on_add(ticket)
-        for sid in ticket.session_ids:
-            self.session_tickets.setdefault(sid, set()).add(ticket.ticket)
-        if ticket.party_id:
-            self.party_tickets.setdefault(ticket.party_id, set()).add(
-                ticket.ticket
+        slot = self.store.add(ticket, active=active)
+        try:
+            self.backend.on_add(ticket, slot)
+        except Exception:
+            # A rejection (bad embedding, device row overflow) must leave
+            # everything as it was.
+            self.store.remove_slots(
+                np.asarray([slot], dtype=np.int32), defer_free=False
             )
-        self.tickets[ticket.ticket] = ticket
-        if active:
-            self.active[ticket.ticket] = ticket
+            raise
         self._update_gauges()
 
     # -------------------------------------------------------------- process
 
-    def process(self):
+    def process(self) -> MatchBatch:
         """One matching interval (reference Process, matchmaker.go:282-441).
 
-        Actives are handed to the backend UNSORTED; each backend orders
-        the subset it actually walks oldest-first (sorting ~100k actives
-        that a pipelined backend immediately filters as in-flight
-        measured ~0.15s/interval)."""
+        Interval bookkeeping is vectorized over the active slot array; the
+        backend returns matches columnar; unregistration is one bulk store
+        call. Per-entry Python objects are only touched on the override /
+        host-only object paths."""
         t0 = time.perf_counter()
-        actives = list(self.active.values())
+        store = self.store
+        meta = store.meta
+        active_slots = store.active_slots()
+        max_intervals = self.config.max_intervals
+
         if self.override_fn is not None:
-            actives.sort(
-                key=operator.attrgetter("created_at", "created_seq")
+            batch, matched_slots, expired_slots = self._process_override(
+                active_slots
             )
-            matched, expired = process_custom(
-                actives,
-                self.tickets,
-                max_intervals=self.config.max_intervals,
-                rev_precision=self.config.rev_precision,
-                override_fn=self.override_fn,
-            )
-            reactivate: set[str] = set()
+            reactivate = np.zeros(0, dtype=np.int32)
         else:
-            matched, expired, reactivate = self.backend.process(
-                actives,
-                self.tickets,
-                max_intervals=self.config.max_intervals,
+            # Interval bump + expiry, vectorized (reference bumps
+            # per-active in the loop; equivalent because matched actives
+            # leave the pool anyway).
+            meta["intervals"][active_slots] += 1
+            iv = meta["intervals"][active_slots]
+            last = (iv >= max_intervals) | (
+                meta["min_count"][active_slots]
+                == meta["max_count"][active_slots]
+            )
+            expired_slots = active_slots[last]
+            batch, matched_slots, reactivate = self.backend.process_slots(
+                active_slots,
+                last,
+                max_intervals=max_intervals,
                 rev_precision=self.config.rev_precision,
             )
 
-        for ticket_id in expired:
-            self.active.pop(ticket_id, None)
-        for ticket_id in reactivate:
-            ticket = self.tickets.get(ticket_id)
-            if ticket is not None and ticket_id not in self.active:
-                self.active[ticket_id] = ticket
-
-        # Remove matched tickets from the pool. A set may have been raced out
-        # by an explicit removal between snapshot and now (possible only for
-        # override fns that suspend); drop such sets defensively.
-        confirmed: list[list[MatchmakerEntry]] = []
-        to_remove: list = []
-        taken: set[str] = set()
-        tickets_map = self.tickets
-        for entry_set in matched:
-            # `taken` guards against an override fn returning overlapping
-            # sets: the first set wins, later ones are dropped (matches the
-            # old unregister-as-you-go behaviour).
-            if all(
-                e.ticket in tickets_map and e.ticket not in taken
-                for e in entry_set
-            ):
-                confirmed.append(entry_set)
-                taken.update(e.ticket for e in entry_set)
-                to_remove.extend(entry_set)
-        self._unregister_entries(to_remove)
+        store.deactivate(expired_slots)
+        if len(matched_slots):
+            self.backend.on_remove_slots(matched_slots)
+            store.remove_slots(matched_slots)
+        store.reactivate(reactivate)
 
         if self.metrics is not None:
             self.metrics.mm_process_time.observe(time.perf_counter() - t0)
-            self.metrics.mm_matched.inc(
-                sum(len(s) for s in confirmed) or 0
-            )
+            self.metrics.mm_matched.inc(batch.entry_count if batch else 0)
             self._update_gauges()
 
-        if confirmed and self.on_matched is not None:
-            self.on_matched(confirmed)
-        return confirmed
+        if len(batch) and self.on_matched is not None:
+            self.on_matched(batch)
+        return batch
+
+    def _process_override(self, active_slots: np.ndarray):
+        """Runtime-override interval: object semantics (the override fn
+        consumes entry lists), small pools by design."""
+        store = self.store
+        actives, ordered, pool = store.oracle_view(active_slots)
+        matched, expired_ids = process_custom(
+            actives,
+            pool,
+            max_intervals=self.config.max_intervals,
+            rev_precision=self.config.rev_precision,
+            override_fn=self.override_fn,
+        )
+        # process_custom bumped object intervals; write back.
+        store.meta["intervals"][ordered] = [t.intervals for t in actives]
+        # An override fn may return overlapping or raced-out sets: first
+        # set wins, later ones drop (old unregister-as-you-go behaviour).
+        confirmed: list[list[MatchmakerEntry]] = []
+        taken: set[str] = set()
+        for entry_set in matched:
+            tids = {e.ticket for e in entry_set}
+            if all(t in store and t not in taken for t in tids):
+                confirmed.append(entry_set)
+                taken |= tids
+        batch, matched_slots = lists_to_batch(confirmed, store)
+        expired_slots = np.asarray(
+            [
+                s
+                for tid in expired_ids
+                if (s := store.slot_by_id(tid)) is not None
+            ],
+            dtype=np.int32,
+        )
+        return batch, matched_slots, expired_slots
 
     # -------------------------------------------------------------- removal
 
-    def _unregister(self, ticket_id: str):
-        ticket = self.tickets.pop(ticket_id, None)
-        if ticket is None:
+    def _remove_slots(self, slots: np.ndarray):
+        if len(slots) == 0:
             return
-        self.active.pop(ticket_id, None)
-        self.backend.on_remove(ticket_id)
-        self._drop_owner_maps(ticket)
+        # API callers may pass duplicate ids; the store requires unique
+        # slots (a duplicate would double-free into the allocator).
+        slots = np.unique(np.asarray(slots, dtype=np.int32))
+        self.backend.on_remove_slots(slots)
+        self.store.remove_slots(slots)
 
-    def _drop_owner_maps(self, ticket: MatchmakerTicket):
-        ticket_id = ticket.ticket
-        for sid in ticket.session_ids:
-            tickets = self.session_tickets.get(sid)
-            if tickets is not None:
-                tickets.discard(ticket_id)
-                if not tickets:
-                    del self.session_tickets[sid]
-        if ticket.party_id:
-            tickets = self.party_tickets.get(ticket.party_id)
-            if tickets is not None:
-                tickets.discard(ticket_id)
-                if not tickets:
-                    del self.party_tickets[ticket.party_id]
-
-    def _unregister_entries(self, entries: list[MatchmakerEntry]):
-        """Bulk form of _unregister for interval churn (~100k matched
-        entries/interval at the bench pool): one backend batch call, local
-        dict maintenance inlined."""
-        tickets_map = self.tickets
-        active = self.active
-        removed_ids: list[str] = []
-        for e in entries:
-            ticket = tickets_map.pop(e.ticket, None)
-            if ticket is None:
-                continue
-            active.pop(e.ticket, None)
-            removed_ids.append(e.ticket)
-            self._drop_owner_maps(ticket)
-        remove_many = getattr(self.backend, "on_remove_many", None)
-        if remove_many is not None:
-            remove_many(removed_ids)
-        else:
-            for tid in removed_ids:
-                self.backend.on_remove(tid)
+    def _unregister(self, ticket_id: str):
+        slot = self.store.slot_by_id(ticket_id)
+        if slot is None:
+            return
+        self._remove_slots(np.asarray([slot], dtype=np.int32))
 
     def remove_session(self, session_id: str, ticket_id: str):
         """Ownership-checked removal (reference matchmaker.go:725)."""
-        if ticket_id not in self.session_tickets.get(session_id, ()):
+        t = self.store.get(ticket_id)
+        if t is None or session_id not in t.session_ids:
             raise MatchmakerError("ticket not found")
         self._unregister(ticket_id)
         self._update_gauges()
 
     def remove_session_all(self, session_id: str):
-        for ticket_id in list(self.session_tickets.get(session_id, ())):
-            self._unregister(ticket_id)
+        slots = [
+            self.store.slot_by_id(t.ticket)
+            for t in self.store.session_tickets(session_id)
+        ]
+        self._remove_slots(
+            np.asarray([s for s in slots if s is not None], dtype=np.int32)
+        )
         self._update_gauges()
 
     def remove_party(self, party_id: str, ticket_id: str):
-        if ticket_id not in self.party_tickets.get(party_id, ()):
+        t = self.store.get(ticket_id)
+        if t is None or t.party_id != party_id:
             raise MatchmakerError("ticket not found")
         self._unregister(ticket_id)
         self._update_gauges()
 
     def remove_party_all(self, party_id: str):
-        for ticket_id in list(self.party_tickets.get(party_id, ())):
-            self._unregister(ticket_id)
+        slots = [
+            self.store.slot_by_id(t.ticket)
+            for t in self.store.party_tickets(party_id)
+        ]
+        self._remove_slots(
+            np.asarray([s for s in slots if s is not None], dtype=np.int32)
+        )
         self._update_gauges()
 
     def remove_all(self, node: str):
         # Single-node build: every ticket belongs to this node.
         if node != self.node:
             return
-        for ticket_id in list(self.tickets):
-            self._unregister(ticket_id)
+        self._remove_slots(self.store.live_slots())
         self._update_gauges()
 
     def remove(self, ticket_ids: list[str]):
-        for ticket_id in ticket_ids:
-            self._unregister(ticket_id)
+        slots = [self.store.slot_by_id(tid) for tid in ticket_ids]
+        self._remove_slots(
+            np.asarray([s for s in slots if s is not None], dtype=np.int32)
+        )
         self._update_gauges()
 
     # ------------------------------------------------------ extract / insert
 
     def extract(self) -> list[MatchmakerExtract]:
         """Export all tickets for node-drain handover (matchmaker.go:684)."""
+        store = self.store
+        iv = store.meta["intervals"]
         out = []
-        for t in self.tickets.values():
+        for s in store.live_slots():
+            t = store.ticket_at[s]
             out.append(
                 MatchmakerExtract(
                     presences=[e.presence for e in t.entries],
@@ -483,7 +596,7 @@ class LocalMatchmaker:
                     numeric_properties=dict(t.numeric_properties),
                     ticket=t.ticket,
                     created_at=t.created_at,
-                    intervals=t.intervals,
+                    intervals=int(iv[s]),
                     embedding=t.embedding,
                 )
             )
@@ -524,14 +637,20 @@ class LocalMatchmaker:
                 parsed_query=parsed,
                 embedding=ex.embedding,
             )
-            self._register(ticket)
+            try:
+                self._register(ticket)
+            except KeyError:
+                # Re-delivered handover batch: the id is already live.
+                self.logger.warn(
+                    "insert: duplicate ticket", ticket=ex.ticket
+                )
 
     # -------------------------------------------------------------- helpers
 
     def _update_gauges(self):
         if self.metrics is not None:
-            self.metrics.mm_tickets.set(len(self.tickets))
-            self.metrics.mm_active_tickets.set(len(self.active))
+            self.metrics.mm_tickets.set(len(self.store))
+            self.metrics.mm_active_tickets.set(self.store.n_active)
 
     def __len__(self) -> int:
-        return len(self.tickets)
+        return len(self.store)

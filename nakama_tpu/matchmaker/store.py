@@ -1,0 +1,320 @@
+"""Slot-centric ticket store: the matchmaker's host-side source of truth.
+
+Round-2 profiling found the interval floor was per-entry Python dict/set
+churn — ~1.5s at ~100k matched entries — in exactly the maps the
+reference keeps per ticket in Go (sessionTickets/partyTickets/indexes,
+reference server/matchmaker.go:171-214). This store re-lays that state
+out columnar, indexed by pool slot:
+
+- ticket *objects* live in one object ndarray (`ticket_at`) — the
+  interval path never walks them; they are only materialized per entry at
+  delivery (lazily, by MatchBatch) and for the host-only oracle path,
+- per-slot metadata (counts, intervals, created, session hashes) are
+  persistent numpy arrays shared with the device backend and the native
+  assembler,
+- id/session/party reverse maps are 64-bit-hash maps in C++
+  (native/tickstore.cpp) updated by ONE bulk call per interval,
+- removed ticket objects drop into a graveyard list freed in the
+  interval's idle gap (`drain()`), so refcount cascades of ~300k objects
+  never land on the interval's critical path (the same treatment the
+  interval loop already gives gc).
+
+Slot allocation is LIFO from slot 0 so the pool stays dense at the low
+end and the device kernel can stop at the high-water mark (device.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .compile import hash64
+from .types import MatchmakerTicket
+
+
+class PyTickStore:
+    """Pure-Python fallback for native.TickStore (toolchain-less hosts).
+    Same interface; per-entry dict cost — correct, not fast."""
+
+    def __init__(self, capacity: int):
+        self._by_id: dict[int, int] = {}
+        self._by_session: dict[int, list[int]] = {}
+        self._by_party: dict[int, list[int]] = {}
+        self._rec: dict[int, tuple[int, list[int], int]] = {}
+
+    def __len__(self) -> int:
+        return len(self._by_id)
+
+    def add(self, slot, id_hash, session_hashes, party_hash):
+        if id_hash in self._by_id:
+            raise KeyError("duplicate ticket id hash")
+        if slot in self._rec:
+            raise RuntimeError(f"slot {slot} already occupied")
+        sessions = [int(h) for h in session_hashes]
+        self._by_id[id_hash] = slot
+        self._rec[slot] = (id_hash, sessions, party_hash)
+        for sh in sessions:
+            self._by_session.setdefault(sh, []).append(slot)
+        if party_hash:
+            self._by_party.setdefault(party_hash, []).append(slot)
+
+    def remove_slots(self, slots):
+        for slot in np.asarray(slots, dtype=np.int64):
+            rec = self._rec.pop(int(slot), None)
+            if rec is None:
+                continue
+            id_hash, sessions, party_hash = rec
+            self._by_id.pop(id_hash, None)
+            for sh in sessions:
+                lst = self._by_session.get(sh)
+                if lst is not None:
+                    lst.remove(int(slot))
+                    if not lst:
+                        del self._by_session[sh]
+            if party_hash:
+                lst = self._by_party.get(party_hash)
+                if lst is not None:
+                    lst.remove(int(slot))
+                    if not lst:
+                        del self._by_party[party_hash]
+
+    def slot_of(self, id_hash):
+        return self._by_id.get(id_hash)
+
+    def session_count(self, session_hash):
+        return len(self._by_session.get(session_hash, ()))
+
+    def party_count(self, party_hash):
+        return len(self._by_party.get(party_hash, ()))
+
+    def session_slots(self, session_hash, cap: int = 4096):
+        return np.asarray(
+            self._by_session.get(session_hash, ())[:cap], dtype=np.int32
+        )
+
+    def party_slots(self, party_hash, cap: int = 4096):
+        return np.asarray(
+            self._by_party.get(party_hash, ())[:cap], dtype=np.int32
+        )
+
+
+def _hash_id(value: str) -> int:
+    return hash64(value) or 1
+
+
+class SlotStore:
+    """Slot allocator + per-slot ticket state + hash reverse maps."""
+
+    def __init__(self, capacity: int, max_party_size: int):
+        self.capacity = capacity
+        self.ticket_at = np.full(capacity, None, dtype=object)
+        self.alive = np.zeros(capacity, dtype=bool)
+        self.active = np.zeros(capacity, dtype=bool)
+        self.meta = {
+            "min_count": np.zeros(capacity, dtype=np.int32),
+            "max_count": np.zeros(capacity, dtype=np.int32),
+            "count_multiple": np.ones(capacity, dtype=np.int32),
+            "count": np.zeros(capacity, dtype=np.int32),
+            "intervals": np.zeros(capacity, dtype=np.int32),
+            "created": np.zeros(capacity, dtype=np.int64),  # ns wall clock
+            "created_seq": np.zeros(capacity, dtype=np.int64),
+            "session_hashes": np.zeros(
+                (capacity, max_party_size), dtype=np.uint64
+            ),
+            "session_counts": np.zeros(capacity, dtype=np.int32),
+        }
+        # Bumped on every slot (re)assignment; pipelined device work
+        # snapshots it at dispatch so collection can drop matches touching
+        # reused slots (tpu.py).
+        self.gen = np.zeros(capacity, dtype=np.int64)
+        self.n_active = 0  # O(1) gauge (the masks are O(capacity) to sum)
+        self._free = list(range(capacity - 1, -1, -1))
+        try:
+            from .. import native
+
+            self.maps = native.TickStore(capacity)
+        except Exception:
+            self.maps = PyTickStore(capacity)
+        self._graveyard: list[np.ndarray] = []
+
+    def __len__(self) -> int:
+        return len(self.maps)
+
+    # ------------------------------------------------------------ mutation
+
+    def add(self, ticket: MatchmakerTicket, active: bool = True) -> int:
+        """Assign a slot and register the ticket. Raises on capacity/dup;
+        leaves no partial state behind on failure."""
+        if not self._free:
+            raise RuntimeError("matchmaker pool capacity exceeded")
+        sessions = sorted(ticket.session_ids)
+        stride = self.meta["session_hashes"].shape[1]
+        if len(sessions) > stride:
+            raise ValueError(
+                f"party size {len(sessions)} exceeds max_party_size {stride}"
+            )
+        sh = np.asarray(
+            [_hash_id(s) for s in sessions], dtype=np.uint64
+        )
+        slot = self._free[-1]
+        self.maps.add(
+            slot,
+            _hash_id(ticket.ticket),
+            sh,
+            _hash_id(ticket.party_id) if ticket.party_id else 0,
+        )
+        self._free.pop()
+        m = self.meta
+        m["min_count"][slot] = ticket.min_count
+        m["max_count"][slot] = ticket.max_count
+        m["count_multiple"][slot] = ticket.count_multiple
+        m["count"][slot] = ticket.count
+        m["intervals"][slot] = ticket.intervals
+        m["created"][slot] = int(ticket.created_at * 1e9)
+        m["created_seq"][slot] = ticket.created_seq
+        m["session_counts"][slot] = len(sessions)
+        m["session_hashes"][slot, : len(sessions)] = sh
+        m["session_hashes"][slot, len(sessions) :] = 0
+        self.ticket_at[slot] = ticket
+        self.alive[slot] = True
+        self.active[slot] = active
+        self.n_active += active
+        self.gen[slot] += 1
+        return slot
+
+    def remove_slots(self, slots: np.ndarray, defer_free: bool = True):
+        """Bulk unregistration (matched/removed tickets). Object refs move
+        to the graveyard; `drain()` frees them off the critical path
+        (`defer_free=False` skips the parking — small rollback paths).
+
+        `slots` must be duplicate-free: the interval path guarantees it by
+        construction (matches are slot-disjoint); API paths dedupe in
+        LocalMatchmaker._remove_slots. A duplicate here would double-free
+        the slot into the free list and poison the allocator."""
+        if len(slots) == 0:
+            return
+        slots = np.asarray(slots, dtype=np.int32)
+        self.maps.remove_slots(slots)
+        if defer_free:
+            self._graveyard.append(self.ticket_at[slots])
+        self.ticket_at[slots] = None
+        self.alive[slots] = False
+        self.n_active -= int(self.active[slots].sum())
+        self.active[slots] = False
+        self.meta["session_counts"][slots] = 0
+        self._free.extend(slots.tolist())
+
+    def deactivate(self, slots: np.ndarray):
+        if len(slots) == 0:
+            return
+        self.n_active -= int(self.active[slots].sum())
+        self.active[slots] = False
+
+    def reactivate(self, slots: np.ndarray):
+        """Re-activate (alive) slots; inactive-only counting."""
+        if len(slots) == 0:
+            return
+        turn_on = self.alive[slots] & ~self.active[slots]
+        self.active[slots] |= turn_on
+        self.n_active += int(turn_on.sum())
+
+    def remove_id(self, ticket_id: str) -> int | None:
+        """Single-ticket removal by id (client cancel paths)."""
+        slot = self.slot_by_id(ticket_id)
+        if slot is None:
+            return None
+        self.remove_slots(np.asarray([slot], dtype=np.int32))
+        return slot
+
+    def drain(self):
+        """Free removed ticket objects; called from the interval idle gap."""
+        self._graveyard.clear()
+
+    # ------------------------------------------------------------- queries
+
+    def slot_by_id(self, ticket_id: str) -> int | None:
+        slot = self.maps.slot_of(_hash_id(ticket_id))
+        if slot is None:
+            return None
+        t = self.ticket_at[slot]
+        # 64-bit collision guard: verify the resolved object really is it.
+        if t is None or t.ticket != ticket_id:
+            return None
+        return slot
+
+    def get(self, ticket_id: str) -> MatchmakerTicket | None:
+        slot = self.slot_by_id(ticket_id)
+        return None if slot is None else self.ticket_at[slot]
+
+    def __contains__(self, ticket_id: str) -> bool:
+        return self.slot_by_id(ticket_id) is not None
+
+    def session_ticket_count(self, session_id: str) -> int:
+        return self.maps.session_count(_hash_id(session_id))
+
+    def party_ticket_count(self, party_id: str) -> int:
+        return self.maps.party_count(_hash_id(party_id))
+
+    def session_tickets(self, session_id: str) -> list[MatchmakerTicket]:
+        out = []
+        for slot in self.maps.session_slots(_hash_id(session_id)):
+            t = self.ticket_at[slot]
+            if t is not None and session_id in t.session_ids:
+                out.append(t)
+        return out
+
+    def party_tickets(self, party_id: str) -> list[MatchmakerTicket]:
+        out = []
+        for slot in self.maps.party_slots(_hash_id(party_id)):
+            t = self.ticket_at[slot]
+            if t is not None and t.party_id == party_id:
+                out.append(t)
+        return out
+
+    def live_slots(self) -> np.ndarray:
+        return np.nonzero(self.alive)[0].astype(np.int32)
+
+    def active_slots(self) -> np.ndarray:
+        return np.nonzero(self.active)[0].astype(np.int32)
+
+    def live_tickets(self) -> list[MatchmakerTicket]:
+        return list(self.ticket_at[self.alive])
+
+    def tickets_by_id(self) -> dict[str, MatchmakerTicket]:
+        """Materialize the id->ticket dict the CPU-oracle candidate scan
+        walks (small pools / host-only actives only — O(pool))."""
+        return {t.ticket: t for t in self.ticket_at[self.alive]}
+
+    def ordered_actives(
+        self, active_slots: np.ndarray
+    ) -> tuple[list[MatchmakerTicket], np.ndarray]:
+        """Active ticket objects ordered oldest-first by (created_at,
+        created_seq), plus the matching ordered slot array. Does NOT sync
+        object intervals — pair with `oracle_view()` for oracle paths."""
+        order = np.lexsort(
+            (
+                self.meta["created_seq"][active_slots],
+                self.meta["created"][active_slots],
+            )
+        )
+        ordered = active_slots[order]
+        ticket_at = self.ticket_at
+        return [ticket_at[s] for s in ordered], ordered
+
+    def oracle_view(
+        self, active_slots: np.ndarray
+    ) -> tuple[list[MatchmakerTicket], np.ndarray, dict[str, MatchmakerTicket]]:
+        """Object-path prelude shared by the CPU oracle, the runtime
+        override, and the TPU host-only fallback: ONE O(pool) walk that
+        syncs every live ticket object's `intervals` from the
+        authoritative array (the oracle's "let them wait" rule reads
+        hit.intervals) and builds the id->ticket dict the candidate scan
+        walks; returns (ordered actives, ordered slots, pool dict)."""
+        iv = self.meta["intervals"]
+        ticket_at = self.ticket_at
+        pool: dict[str, MatchmakerTicket] = {}
+        for s in self.live_slots():
+            t = ticket_at[s]
+            t.intervals = int(iv[s])
+            pool[t.ticket] = t
+        actives, ordered = self.ordered_actives(active_slots)
+        return actives, ordered, pool

@@ -50,7 +50,6 @@ from .compile import (
     compile_features,
     compile_query,
     exact_features,
-    hash64,
     hash_str,
 )
 from .device import (
@@ -64,7 +63,7 @@ from .device import (
 )
 from .device2 import MAX_COLS, topk_candidates_big
 from .process import _mutual, process_default
-from .types import MatchmakerEntry, MatchmakerTicket
+from .types import MatchBatch, MatchmakerTicket
 
 
 _CQ_MISS = object()  # cache-miss sentinel (None is a valid cached value)
@@ -170,18 +169,10 @@ class TpuBackend:
                 out_shardings=replicated,
             )
 
-        # Host-side per-slot metadata for the native assembler.
-        sps = config.max_party_size
-        self.meta = {
-            "min_count": np.zeros(cap, dtype=np.int32),
-            "max_count": np.zeros(cap, dtype=np.int32),
-            "count_multiple": np.ones(cap, dtype=np.int32),
-            "count": np.zeros(cap, dtype=np.int32),
-            "intervals": np.zeros(cap, dtype=np.int32),
-            "created": np.zeros(cap, dtype=np.int64),
-            "session_hashes": np.zeros((cap, sps), dtype=np.uint64),
-            "session_counts": np.zeros(cap, dtype=np.int32),
-        }
+        # Host-side per-slot metadata (SlotStore.meta) is bound at
+        # attach(); the assembler and the collect re-sort read it there.
+        self.store = None
+        self.meta = None
         # Exact query/value mirrors for vectorized match validation.
         s = self.s
         self.exact = {
@@ -202,23 +193,30 @@ class TpuBackend:
             "q_has_should": np.zeros(cap, dtype=bool),
             "q_exact_ok": np.zeros(cap, dtype=bool),
         }
-        self.ticket_at: list[MatchmakerTicket | None] = [None] * cap
-        # Bumped on every slot (re)assignment; a pipelined interval snapshots
-        # it at dispatch so collection can drop matches touching reused slots.
-        self._slot_gen = np.zeros(cap, dtype=np.int64)
+        # Per-slot masks replace the round-2 id-keyed sets: interval-path
+        # updates are O(batch) numpy instead of per-entry set churn.
+        # host_only keeps a small id-set view for observability/tests —
+        # host-only tickets are few by design (budgeted, config).
+        self.host_only_mask = np.zeros(cap, dtype=bool)
         self.host_only: set[str] = set()
-        self._should_tickets: set[str] = set()
-        self._embedding_tickets: set[str] = set()
+        self._should_mask = np.zeros(cap, dtype=bool)
+        self._should_count = 0
+        self._emb_mask = np.zeros(cap, dtype=bool)
+        self._emb_count = 0
+        # Per-process scratch: slots already claimed by an accepted match
+        # this interval (reset each process_slots call).
+        self._sel_mask = np.zeros(cap, dtype=bool)
         # Monotone lower bound on live created_seq: keeps the kernel's
         # wait-time tie-break penalty small on long-lived servers.
         self._created_base = 0
         # Pipelined-interval state: dispatched-but-uncollected work, oldest
         # first. Collection drains only READY results (device + transfer
         # complete), so process() never blocks on the device; backpressure
-        # caps outstanding cohorts. Covered tickets must not be
-        # re-dispatched meanwhile (_in_flight).
+        # caps outstanding cohorts. Covered slots must not be
+        # re-dispatched meanwhile (mask cleared on collection and on slot
+        # reuse by a new add).
         self._pipeline_queue: deque = deque()
-        self._in_flight: set[str] = set()
+        self._in_flight_mask = np.zeros(cap, dtype=bool)
         # Row-bucket shapes already compiled (or prewarmed) this process.
         self._warmed_buckets: set[tuple] = set()
         # query string -> CompiledQuery | None (None = host-only).
@@ -227,6 +225,13 @@ class TpuBackend:
         # kernel); stale-wide ranges only cost precision, never correctness.
         self._grid_lo = np.full(self.fn, np.inf)
         self._grid_hi = np.full(self.fn, -np.inf)
+
+    def attach(self, store):
+        """Bind the LocalMatchmaker's SlotStore: one slot space shared by
+        host metadata, reverse maps, and device rows."""
+        self.store = store
+        self.meta = store.meta
+        self.pool.store = store
 
     # -------------------------------------------------- pool notifications
 
@@ -241,16 +246,10 @@ class TpuBackend:
         np.minimum(self._grid_lo, masked_lo, out=self._grid_lo)
         np.maximum(self._grid_hi, masked_hi, out=self._grid_hi)
 
-    def on_add(self, ticket: MatchmakerTicket, pool_id: int = 0):
+    def on_add(self, ticket: MatchmakerTicket, slot: int, pool_id: int = 0):
         # Validate and compile everything BEFORE mutating any backend state,
-        # so a rejected add (bad embedding, pool capacity, party size) leaves
-        # the backend exactly as it was.
-        sessions = sorted(ticket.session_ids)
-        stride = self.meta["session_hashes"].shape[1]
-        if len(sessions) > stride:
-            raise ValueError(
-                f"party size {len(sessions)} exceeds max_party_size {stride}"
-            )
+        # so a rejected add (bad embedding) leaves the backend exactly as it
+        # was (the caller rolls back its SlotStore registration on raise).
         emb = np.zeros(self.d, dtype=np.float32)
         if ticket.embedding is not None:
             e = np.asarray(ticket.embedding, dtype=np.float32)
@@ -328,9 +327,11 @@ class TpuBackend:
             "created": np.int32(ticket.created_seq),
             "flags": np.int32(flags),
         }
-        slot = self.pool.add(ticket.ticket, row)
-        if len(self.pool) == 1:
+        self.pool.add(slot, row)
+        if len(self.store) == 1:
             self._created_base = ticket.created_seq
+        self._in_flight_mask[slot] = False  # slot reuse: new ticket
+        self.host_only_mask[slot] = host_only
         if host_only:
             self.host_only.add(ticket.ticket)
             # The host fallback is O(actives x pool) Python — fine for a
@@ -344,24 +345,13 @@ class TpuBackend:
                     "(3 numeric + 2 string slots are builtin)",
                     count=n,
                 )
-        if cq is not None and cq.has_should:
-            self._should_tickets.add(ticket.ticket)
-        if ticket.embedding is not None:
-            self._embedding_tickets.add(ticket.ticket)
+        has_should = cq is not None and cq.has_should
+        self._should_mask[slot] = has_should
+        self._should_count += has_should
+        has_emb = ticket.embedding is not None
+        self._emb_mask[slot] = has_emb
+        self._emb_count += has_emb
 
-        m = self.meta
-        m["min_count"][slot] = ticket.min_count
-        m["max_count"][slot] = ticket.max_count
-        m["count_multiple"][slot] = ticket.count_multiple
-        m["count"][slot] = ticket.count
-        m["intervals"][slot] = ticket.intervals
-        m["created"][slot] = int(ticket.created_at * 1e9)
-        m["session_counts"][slot] = len(sessions)
-        for i, sid in enumerate(sessions):
-            m["session_hashes"][slot, i] = hash64(sid)
-        self.ticket_at[slot] = ticket
-
-        self._slot_gen[slot] += 1
         ex = self.exact
         num64, str64 = exact_features(ticket, self.registry)
         ex["v_num"][slot] = num64
@@ -387,112 +377,103 @@ class TpuBackend:
         else:
             ex["q_exact_ok"][slot] = False
 
-    def on_remove(self, ticket_id: str):
-        slot = self.pool.slot_of.get(ticket_id)
-        if slot is not None:
-            self.ticket_at[slot] = None
-            self.meta["session_counts"][slot] = 0
-        self.pool.remove(ticket_id)
-        self.host_only.discard(ticket_id)
-        self._should_tickets.discard(ticket_id)
-        self._embedding_tickets.discard(ticket_id)
-
-    def on_remove_many(self, ticket_ids: list[str]):
-        """Bulk removal: numpy/set side effects batched (the per-call form
-        measured ~0.9s/interval at the 100k bench's ~100k-entry churn)."""
-        gone_slots = self.pool.remove_many(ticket_ids)
-        ticket_at = self.ticket_at
-        for slot in gone_slots:
-            ticket_at[slot] = None
-        if gone_slots:
-            self.meta["session_counts"][np.asarray(gone_slots)] = 0
-        if self.host_only:
-            self.host_only.difference_update(ticket_ids)
-        if self._should_tickets:
-            self._should_tickets.difference_update(ticket_ids)
-        if self._embedding_tickets:
-            self._embedding_tickets.difference_update(ticket_ids)
+    def on_remove_slots(self, slots: np.ndarray):
+        """Bulk removal by slot array — called by LocalMatchmaker BEFORE
+        the SlotStore clears `ticket_at`, so id-set views can resolve.
+        All mask maintenance is O(batch) numpy; the only per-item Python
+        is over host-only slots (few by design)."""
+        if len(slots) == 0:
+            return
+        slots = np.asarray(slots, dtype=np.int32)
+        self.pool.remove_slots(slots)
+        hm = self.host_only_mask[slots]
+        if hm.any():
+            ticket_at = self.store.ticket_at
+            for s in slots[hm]:
+                t = ticket_at[s]
+                if t is not None:
+                    self.host_only.discard(t.ticket)
+            self.host_only_mask[slots] = False
+        self._should_count -= int(self._should_mask[slots].sum())
+        self._should_mask[slots] = False
+        self._emb_count -= int(self._emb_mask[slots].sum())
+        self._emb_mask[slots] = False
+        self._in_flight_mask[slots] = False
 
     # -------------------------------------------------------------- process
 
-    def process(
+    def process_slots(
         self,
-        actives: list[MatchmakerTicket],
-        pool: dict[str, MatchmakerTicket],
+        active_slots: np.ndarray,  # i32 [A], interval-bumped by the caller
+        last_interval: np.ndarray,  # bool [A]
         *,
         max_intervals: int,
         rev_precision: bool,
-    ) -> tuple[list[list[MatchmakerEntry]], list[str], set[str]]:
-        # Interval bookkeeping, vectorized (reference bumps per-active in the
-        # loop; equivalent because matched actives leave the pool anyway).
-        expired: list[str] = []
-        device_actives: list[MatchmakerTicket] = []
-        host_actives: list[MatchmakerTicket] = []
-        for t in actives:
-            t.intervals += 1
-            if t.intervals >= max_intervals or t.min_count == t.max_count:
-                expired.append(t.ticket)
-            (host_actives if t.ticket in self.host_only else device_actives).append(t)
+    ) -> tuple[MatchBatch, np.ndarray, np.ndarray]:
+        """One interval, fully columnar: returns (batch, matched_slots,
+        reactivate_slots). The caller (LocalMatchmaker) owns interval
+        bumping, expiry deactivation, and store removal of matched_slots.
 
-        matched: list[list[MatchmakerEntry]] = []
-        selected: set[str] = set()
-        work = None
+        No step here is O(entries) Python — that per-entry host
+        bookkeeping measured ~1.5s/interval at ~100k matched entries in
+        round 2 and was the north-star latency floor."""
+        meta = self.meta
         pipelined = self.config.interval_pipelining
         # Per-interval observability breadcrumb (SURVEY §5: device timing
         # breadcrumbs; the round-1 perf hole was diagnosed blind without
         # these).
+        host_sel = self.host_only_mask[active_slots]
+        n_host = int(host_sel.sum())
         crumb: dict = {
-            "actives": len(actives),
-            "host_actives": len(host_actives),
+            "actives": len(active_slots),
+            "host_actives": n_host,
         }
         span = self.tracing.span
+        if n_host:
+            host_slots = active_slots[host_sel]
+            device_slots = active_slots[~host_sel]
+            device_last = last_interval[~host_sel]
+        else:
+            host_slots = None
+            device_slots = active_slots
+            device_last = last_interval
         # Only work queued BEFORE this call may be collected this call:
         # this interval's own dispatch always gets at least one interval
         # of overlap (and tests rely on the deterministic lag).
         collectable = len(self._pipeline_queue)
 
-        if pipelined and self._in_flight:
-            # A ticket already dispatched and awaiting collection must not
+        if pipelined and self._pipeline_queue:
+            # A slot already dispatched and awaiting collection must not
             # be dispatched again: its first result would mark it matched
             # and the duplicate's matches all drop as stale — pure wasted
             # device work that was measured doubling the interval time.
-            device_actives = [
-                t for t in device_actives
-                if t.ticket not in self._in_flight
-            ]
+            ff = ~self._in_flight_mask[device_slots]
+            device_slots = device_slots[ff]
+            device_last = device_last[ff]
 
-        if device_actives:
-            # Oldest-first fairness for the greedy assembler; sorted here
-            # (not in LocalMatchmaker) so collect-only intervals never pay
-            # a 100k-element sort for rows they won't dispatch.
-            device_actives.sort(
-                key=lambda t: (t.created_at, t.created_seq)
+        work = None
+        if len(device_slots):
+            # Oldest-first fairness for the greedy assembler (lexsort:
+            # primary created_at ns, tie created_seq).
+            order = np.lexsort(
+                (
+                    meta["created_seq"][device_slots],
+                    meta["created"][device_slots],
+                )
             )
-            slots = np.asarray(
-                [self.pool.slot_of[t.ticket] for t in device_actives],
-                dtype=np.int32,
-            )
-            self.meta["intervals"][slots] = [
-                t.intervals for t in device_actives
-            ]
-            last_interval = np.asarray(
-                [
-                    t.intervals >= max_intervals or t.min_count == t.max_count
-                    for t in device_actives
-                ],
-                dtype=np.uint8,
-            )
+            device_slots = np.ascontiguousarray(device_slots[order])
+            device_last = device_last[order]
             with span(crumb, "flush_s"):
                 self.pool.flush()
             with span(crumb, "dispatch_s"):
-                pending = self._dispatch(slots, rev_precision)
-            gen_snap = self._slot_gen.copy() if pipelined else self._slot_gen
-            cohort = (
-                [t.ticket for t in device_actives] if pipelined else None
-            )
+                pending = self._dispatch(device_slots, rev_precision)
+            gen_snap = self.store.gen.copy() if pipelined else self.store.gen
             work = (
-                pending, slots, last_interval, len(device_actives),
-                gen_snap, cohort,
+                pending,
+                device_slots,
+                np.ascontiguousarray(device_last, dtype=np.uint8),
+                len(device_slots),
+                gen_snap,
             )
             if pipelined:
                 # Queue it; collection below drains only completed results,
@@ -500,7 +481,7 @@ class TpuBackend:
                 # does everything else (ticket properties are immutable, so
                 # its candidates cannot go stale — only dead slots, masked
                 # at collection).
-                self._in_flight.update(cohort)
+                self._in_flight_mask[device_slots] = True
                 self._pipeline_queue.append(work)
                 work = None
 
@@ -519,101 +500,135 @@ class TpuBackend:
                 ready_works.append(self._pipeline_queue.popleft())
                 collectable -= 1
 
-        # Tickets whose assembled match was dropped after they may already
+        sel = self._sel_mask
+        sel[:] = False
+        flat_parts: list[np.ndarray] = []
+        size_parts: list[np.ndarray] = []
+        # Slots whose assembled match was dropped after they may already
         # have gone inactive (pipelined collection lags dispatch by one
         # interval): give them another active interval.
-        reactivate: set[str] = set()
+        react_parts: list[np.ndarray] = []
 
-        if host_actives:
-            # Runs while the device computes and the candidate lists stream
-            # back.
-            host_actives.sort(key=lambda t: (t.created_at, t.created_seq))
-            host_matched, _ = process_default(
-                host_actives,
-                pool,
-                max_intervals=max_intervals,
-                rev_precision=rev_precision,
-                bump_intervals=False,
-                preselected=selected,
-            )
-            for entry_set in host_matched:
-                matched.append(entry_set)
-                selected.update(e.ticket for e in entry_set)
+        if host_slots is not None:
+            # Runs while the device computes and the candidate lists
+            # stream back. Object path: sync ticket-object intervals from
+            # the authoritative arrays first (the oracle's "let them wait"
+            # rule reads hit.intervals) — O(pool), paid only when exotic
+            # host-only queries exist.
+            with span(crumb, "host_s"):
+                host_actives, _, pool_view = self.store.oracle_view(
+                    host_slots
+                )
+                host_matched, _ = process_default(
+                    host_actives,
+                    pool_view,
+                    max_intervals=max_intervals,
+                    rev_precision=rev_precision,
+                    bump_intervals=False,
+                )
+                for entry_set in host_matched:
+                    uniq = list(
+                        dict.fromkeys(e.ticket for e in entry_set)
+                    )
+                    slots_m = np.asarray(
+                        [self.store.slot_by_id(t) for t in uniq],
+                        dtype=np.int32,
+                    )
+                    flat_parts.append(slots_m)
+                    size_parts.append(
+                        np.asarray([len(slots_m)], dtype=np.int64)
+                    )
+                    sel[slots_m] = True
 
         for work in ready_works:
-            w_pending, w_slots, w_last_interval, w_n, w_gen, w_cohort = work
-            if w_cohort is not None:
-                self._in_flight.difference_update(w_cohort)
+            w_pending, w_slots, w_last, w_n, w_gen = work
+            if pipelined:
+                # Release only slots whose in-flight claim is still THIS
+                # cohort's: a slot freed, reused, and re-dispatched by a
+                # later still-queued cohort (gen changed) keeps its bit or
+                # the next interval triple-dispatches it.
+                self._in_flight_mask[
+                    w_slots[w_gen[w_slots] == self.store.gen[w_slots]]
+                ] = False
             with span(crumb, "collect_s"):
                 cand_np = self._collect(w_pending, w_n)
             with span(crumb, "assemble_s"):
                 n_matches, offsets, flat = native.assemble_arrays(
                     w_slots,
-                    w_last_interval,
+                    w_last,
                     cand_np,
-                    min_count=self.meta["min_count"],
-                    max_count=self.meta["max_count"],
-                    count_multiple=self.meta["count_multiple"],
-                    count=self.meta["count"],
-                    intervals=self.meta["intervals"],
-                    created=self.meta["created"],
-                    session_hashes=self.meta["session_hashes"],
-                    session_counts=self.meta["session_counts"],
+                    min_count=meta["min_count"],
+                    max_count=meta["max_count"],
+                    count_multiple=meta["count_multiple"],
+                    count=meta["count"],
+                    intervals=meta["intervals"],
+                    created=meta["created"],
+                    session_hashes=meta["session_hashes"],
+                    session_counts=meta["session_counts"],
                 )
             with span(crumb, "validate_s"):
                 ok = self._validate_bulk(
                     n_matches, offsets, flat, rev_precision
                 )
-            # Per-match accept/drop, vectorized: a Python loop over ~50k
-            # matches with per-match numpy ops measured ~3s/interval on the
-            # 100k bench — the aggregations below are O(total entries) numpy
-            # plus one slot->ticket sweep.
-            total = int(offsets[n_matches])
-            flat_t = flat[:total]
-            sizes = offsets[1 : n_matches + 1] - offsets[:n_matches]
-            mid = np.repeat(np.arange(n_matches), sizes)
-            # stale: a slot was reused between dispatch and collection
-            # (pipelined interval) — its properties/query no longer match
-            # what the kernel scored, so the match must be dropped.
-            stale_e = w_gen[flat_t] != self._slot_gen[flat_t]
-            ticket_at = self.ticket_at
-            tickets_flat = [ticket_at[s] for s in flat_t]
-            dead_e = np.fromiter(
-                (t is None for t in tickets_flat), bool, total
-            )
-            if selected:
-                sel_e = np.fromiter(
-                    (t is not None and t.ticket in selected
-                     for t in tickets_flat),
-                    bool,
-                    total,
+            with span(crumb, "accept_s"):
+                total = int(offsets[n_matches])
+                flat_t = flat[:total]
+                sizes = (
+                    offsets[1 : n_matches + 1] - offsets[:n_matches]
+                ).astype(np.int64)
+                mid = np.repeat(np.arange(n_matches), sizes)
+                # stale: a slot was reused between dispatch and collection
+                # (pipelined interval) — its properties/query no longer
+                # match what the kernel scored; dead: removed meanwhile;
+                # sel: claimed by an earlier accepted match this interval.
+                bad_e = (
+                    (w_gen[flat_t] != self.store.gen[flat_t])
+                    | ~self.store.alive[flat_t]
+                    | sel[flat_t]
                 )
-                dead_e |= sel_e
-            bad = ~ok
-            np.logical_or.at(bad, mid, stale_e | dead_e)
-            for i in np.nonzero(bad)[0] if pipelined else ():
-                # Only the pipeline lag can strand an inactive ticket;
-                # non-pipelined drops keep reference single-shot semantics.
-                for t in tickets_flat[offsets[i] : offsets[i + 1]]:
-                    if t is not None:
-                        reactivate.add(t.ticket)
-            accepted: list = []
-            for i in np.nonzero(~bad)[0]:
-                tickets = tickets_flat[offsets[i] : offsets[i + 1]]
-                entries: list[MatchmakerEntry] = []
-                for t in tickets:
-                    entries.extend(t.entries)
-                matched.append(entries)
-                accepted.extend(tickets)
-            # One bulk update instead of ~matches small ones (matches are
-            # slot-disjoint, so order is irrelevant); measured ~0.5s/interval
-            # at the 100k bench as per-match set.update calls.
-            selected.update(t.ticket for t in accepted)
+                bad = ~ok
+                np.logical_or.at(bad, mid, bad_e)
+                if pipelined and bad.any():
+                    # Only the pipeline lag can strand an inactive ticket;
+                    # non-pipelined drops keep reference single-shot
+                    # semantics.
+                    dropped = flat_t[bad[mid]]
+                    dropped = dropped[
+                        self.store.alive[dropped] & ~sel[dropped]
+                    ]
+                    react_parts.append(dropped)
+                good = ~bad
+                good_flat = flat_t[good[mid]]
+                sel[good_flat] = True
+                flat_parts.append(good_flat)
+                size_parts.append(sizes[good])
 
-        reactivate -= selected
-        crumb["matched_entries"] = sum(len(m) for m in matched)
+        if flat_parts:
+            matched_slots = np.concatenate(flat_parts).astype(
+                np.int32, copy=False
+            )
+            all_sizes = np.concatenate(size_parts)
+            offsets_out = np.zeros(len(all_sizes) + 1, dtype=np.int64)
+            np.cumsum(all_sizes, out=offsets_out[1:])
+        else:
+            matched_slots = np.zeros(0, dtype=np.int32)
+            offsets_out = np.zeros(1, dtype=np.int64)
+        batch = MatchBatch(
+            offsets_out,
+            matched_slots,
+            self.store.ticket_at,
+            counts=meta["count"],
+        )
+
+        if react_parts:
+            reactivate = np.unique(np.concatenate(react_parts))
+            reactivate = reactivate[~sel[reactivate]].astype(np.int32)
+        else:
+            reactivate = np.zeros(0, dtype=np.int32)
+
+        crumb["matched_entries"] = batch.entry_count
         self.tracing.record(crumb)
-        return matched, expired, reactivate
+        return batch, matched_slots, reactivate
 
     def wait_idle(self, timeout: float | None = None):
         """Block until every dispatched cohort's compute + D2H completed
@@ -631,8 +646,8 @@ class TpuBackend:
         """Launch the device top-K for the given active slots; returns an
         opaque pending handle whose transfer is already in flight."""
         hw = self.pool.high_water
-        with_should = bool(self._should_tickets)
-        with_embedding = bool(self._embedding_tickets)
+        with_should = self._should_count > 0
+        with_embedding = self._emb_count > 0
         if self._mesh is not None:
             return self._dispatch_sharded(
                 slots, rev, with_should, with_embedding
@@ -923,7 +938,7 @@ class TpuBackend:
         ok = pair_ok.all(axis=(1, 2))
         for i in np.nonzero(fallback)[0]:
             tickets = [
-                self.ticket_at[s]
+                self.store.ticket_at[s]
                 for s in flat[offsets[i] : offsets[i + 1]]
             ]
             ok[i] = all(t is not None for t in tickets) and all(

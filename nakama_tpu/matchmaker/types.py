@@ -97,6 +97,92 @@ class MatchmakerTicket:
         return doc
 
 
+class MatchBatch:
+    """Columnar view of one interval's formed matches.
+
+    The interval path produces matches as (CSR offsets, flat slot array)
+    straight out of the native assembler; this wrapper exposes them to
+    consumers WITHOUT materializing ~100k per-entry Python objects on the
+    interval's critical path (the round-2 host floor). It behaves as a
+    sequence of entry lists — ``len``, iteration, indexing — materializing
+    each match's `MatchmakerEntry` list lazily from the slot-indexed
+    ticket array; columnar consumers (metrics, the bench, batched envelope
+    fan-out) read `.offsets` / `.slots` / `.entry_count` directly.
+    """
+
+    __slots__ = ("offsets", "slots", "_tickets", "_counts", "_cache")
+
+    def __init__(self, offsets, slots, ticket_at, counts=None):
+        self.offsets = offsets  # i32/i64 [n_matches + 1]
+        self.slots = slots  # i32 [total ticket slots]
+        # Snapshot object refs + entry counts NOW (two vectorized fancy
+        # indexes): matched slots are store-removed right after delivery,
+        # so slot-indexed lookups would read None by the time a lazy
+        # consumer materializes entries.
+        self._tickets = None if ticket_at is None else ticket_at[slots]
+        self._counts = None if counts is None else counts[slots]
+        self._cache: dict[int, list[MatchmakerEntry]] = {}
+
+    @classmethod
+    def from_lists(cls, matched: list[list["MatchmakerEntry"]]):
+        """Adapter for object-path producers (CPU oracle, runtime
+        overrides): wraps pre-built entry lists without slot data."""
+        batch = cls(None, None, None)
+        batch._cache = dict(enumerate(matched))
+        batch.offsets = None
+        return batch
+
+    def __len__(self) -> int:
+        if self.offsets is None:
+            return len(self._cache)
+        return len(self.offsets) - 1
+
+    def __getitem__(self, i: int) -> list["MatchmakerEntry"]:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        hit = self._cache.get(i)
+        if hit is None:
+            entries: list[MatchmakerEntry] = []
+            for t in self._tickets[self.offsets[i] : self.offsets[i + 1]]:
+                entries.extend(t.entries)
+            self._cache[i] = hit = entries
+        return hit
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def __eq__(self, other):
+        if isinstance(other, MatchBatch):
+            other = list(other)
+        if isinstance(other, list):
+            return list(self) == other
+        return NotImplemented
+
+    @property
+    def entry_count(self) -> int:
+        """Total matched entries, without materializing entry objects."""
+        if self.offsets is None:
+            return sum(len(m) for m in self._cache.values())
+        if self._counts is not None:
+            return int(self._counts.sum())
+        return sum(len(m) for m in self)
+
+    def tickets(self, i: int) -> list["MatchmakerTicket"]:
+        """The ticket objects of match i (active ticket last)."""
+        if self.offsets is None:
+            raise ValueError("object-path batch has no slot data")
+        return list(self._tickets[self.offsets[i] : self.offsets[i + 1]])
+
+
 @dataclass
 class MatchmakerExtract:
     """Ticket handover/checkpoint format for node drain

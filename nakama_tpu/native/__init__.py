@@ -34,8 +34,13 @@ def load() -> ctypes.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        src = os.path.join(_DIR, "assembler.cpp")
-        if not os.path.exists(_LIB_PATH) or _newer(src, _LIB_PATH):
+        srcs = [
+            os.path.join(_DIR, "assembler.cpp"),
+            os.path.join(_DIR, "tickstore.cpp"),
+        ]
+        if not os.path.exists(_LIB_PATH) or any(
+            _newer(src, _LIB_PATH) for src in srcs
+        ):
             try:
                 subprocess.run(
                     ["make", "-C", _DIR, "-s"],
@@ -50,8 +55,105 @@ def load() -> ctypes.CDLL:
                 ) from e
         lib = ctypes.CDLL(_LIB_PATH)
         lib.mm_assemble.restype = ctypes.c_int32
+        lib.ts_create.restype = ctypes.c_void_p
+        lib.ts_create.argtypes = [ctypes.c_int32]
+        lib.ts_destroy.argtypes = [ctypes.c_void_p]
+        lib.ts_len.restype = ctypes.c_int64
+        lib.ts_len.argtypes = [ctypes.c_void_p]
+        lib.ts_add.restype = ctypes.c_int32
+        lib.ts_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint64,
+        ]
+        lib.ts_remove_slots.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ]
+        for fn in (lib.ts_slot_of, lib.ts_session_count, lib.ts_party_count):
+            fn.restype = ctypes.c_int32
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        for fn in (lib.ts_session_slots, lib.ts_party_slots):
+            fn.restype = ctypes.c_int32
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+                ctypes.c_int32,
+            ]
         _lib = lib
         return lib
+
+
+class TickStore:
+    """Hash-keyed ticket registry (id/session/party -> slots) with bulk
+    slot-array removal — the native replacement for the per-entry Python
+    dict churn of matched-ticket unregistration (reference maintains these
+    maps in Go, server/matchmaker.go:171-214)."""
+
+    def __init__(self, capacity: int):
+        self._lib = load()
+        self._h = ctypes.c_void_p(self._lib.ts_create(capacity))
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.ts_destroy(h)
+
+    def __len__(self) -> int:
+        return int(self._lib.ts_len(self._h))
+
+    def add(
+        self,
+        slot: int,
+        id_hash: int,
+        session_hashes: np.ndarray,  # u64 [n]
+        party_hash: int,
+    ):
+        rc = self._lib.ts_add(
+            self._h,
+            ctypes.c_int32(slot),
+            ctypes.c_uint64(id_hash),
+            _ptr(session_hashes, np.uint64),
+            ctypes.c_int32(len(session_hashes)),
+            ctypes.c_uint64(party_hash),
+        )
+        if rc == -1:
+            raise KeyError("duplicate ticket id hash")
+        if rc == -2:
+            raise RuntimeError(f"slot {slot} already occupied")
+
+    def remove_slots(self, slots: np.ndarray):
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        self._lib.ts_remove_slots(
+            self._h, _ptr(slots, np.int32), ctypes.c_int32(len(slots))
+        )
+
+    def slot_of(self, id_hash: int) -> int | None:
+        slot = self._lib.ts_slot_of(self._h, ctypes.c_uint64(id_hash))
+        return None if slot < 0 else slot
+
+    def session_count(self, session_hash: int) -> int:
+        return self._lib.ts_session_count(
+            self._h, ctypes.c_uint64(session_hash)
+        )
+
+    def party_count(self, party_hash: int) -> int:
+        return self._lib.ts_party_count(
+            self._h, ctypes.c_uint64(party_hash)
+        )
+
+    def session_slots(self, session_hash: int, cap: int = 4096) -> np.ndarray:
+        out = np.empty(cap, dtype=np.int32)
+        n = self._lib.ts_session_slots(
+            self._h, ctypes.c_uint64(session_hash), _ptr(out, np.int32),
+            ctypes.c_int32(cap),
+        )
+        return out[:n]
+
+    def party_slots(self, party_hash: int, cap: int = 4096) -> np.ndarray:
+        out = np.empty(cap, dtype=np.int32)
+        n = self._lib.ts_party_slots(
+            self._h, ctypes.c_uint64(party_hash), _ptr(out, np.int32),
+            ctypes.c_int32(cap),
+        )
+        return out[:n]
 
 
 def _ptr(arr: np.ndarray, dtype) -> ctypes.c_void_p:

@@ -1,0 +1,155 @@
+// Slot-centric ticket registry — the bulk-bookkeeping tail of the
+// matchmaker interval.
+//
+// The reference maintains per-ticket reverse maps in Go
+// (sessionTickets/partyTickets, reference server/matchmaker.go:171-214)
+// and unlinks matched tickets one at a time inside the Process loop. At
+// the 100k-ticket TPU pool that per-entry host bookkeeping measured
+// ~0.5s/interval in Python (round-2 profile) — this store replaces it
+// with hash maps keyed by 64-bit hashes, updated by one bulk call per
+// interval over the matched slot array.
+//
+// Ids never cross the boundary as strings: the Python side hashes
+// ticket/session/party ids to u64 (matchmaker/compile.py hash64) and
+// resolves hash->slot->ticket-object through its own slot-indexed object
+// array, guarding the (negligible, ~2^-35 at 100k live ids) collision
+// case by comparing the resolved object's id.
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct SlotRec {
+    uint64_t id_hash = 0;
+    uint64_t party_hash = 0;
+    std::vector<uint64_t> sessions;
+    bool occupied = false;
+};
+
+struct Store {
+    std::vector<SlotRec> slots;
+    std::unordered_map<uint64_t, int32_t> by_id;
+    // Values are tiny (MaxTickets per owner, reference config.go:973);
+    // swap-pop keeps removal O(owner tickets).
+    std::unordered_map<uint64_t, std::vector<int32_t>> by_session;
+    std::unordered_map<uint64_t, std::vector<int32_t>> by_party;
+    int64_t live = 0;
+};
+
+void multimap_drop(std::unordered_map<uint64_t, std::vector<int32_t>>& map,
+                   uint64_t key, int32_t slot) {
+    auto it = map.find(key);
+    if (it == map.end()) return;
+    std::vector<int32_t>& v = it->second;
+    for (size_t i = 0; i < v.size(); ++i) {
+        if (v[i] == slot) {
+            v[i] = v.back();
+            v.pop_back();
+            break;
+        }
+    }
+    if (v.empty()) map.erase(it);
+}
+
+int32_t copy_out(const std::unordered_map<uint64_t, std::vector<int32_t>>& map,
+                 uint64_t key, int32_t* out, int32_t cap) {
+    auto it = map.find(key);
+    if (it == map.end()) return 0;
+    int32_t n = 0;
+    for (int32_t s : it->second) {
+        if (n >= cap) break;
+        out[n++] = s;
+    }
+    return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ts_create(int32_t capacity) {
+    Store* st = new Store();
+    st->slots.resize(static_cast<size_t>(capacity));
+    return st;
+}
+
+void ts_destroy(void* h) { delete static_cast<Store*>(h); }
+
+int64_t ts_len(void* h) { return static_cast<Store*>(h)->live; }
+
+// Returns 0 on success, -1 if the id hash is already registered, -2 if
+// the slot is occupied (allocator bug — caller owns the free list).
+int32_t ts_add(void* h, int32_t slot, uint64_t id_hash,
+               const uint64_t* sessions, int32_t n_sessions,
+               uint64_t party_hash) {
+    Store* st = static_cast<Store*>(h);
+    if (!st->by_id.emplace(id_hash, slot).second) return -1;
+    SlotRec& rec = st->slots[slot];
+    if (rec.occupied) {
+        st->by_id.erase(id_hash);
+        return -2;
+    }
+    rec.occupied = true;
+    rec.id_hash = id_hash;
+    rec.party_hash = party_hash;
+    rec.sessions.assign(sessions, sessions + n_sessions);
+    for (int32_t i = 0; i < n_sessions; ++i)
+        st->by_session[sessions[i]].push_back(slot);
+    if (party_hash) st->by_party[party_hash].push_back(slot);
+    ++st->live;
+    return 0;
+}
+
+// Bulk unregistration: one call per interval over the matched slot
+// array. Unoccupied slots are skipped (idempotent).
+void ts_remove_slots(void* h, const int32_t* slots, int32_t n) {
+    Store* st = static_cast<Store*>(h);
+    for (int32_t i = 0; i < n; ++i) {
+        SlotRec& rec = st->slots[slots[i]];
+        if (!rec.occupied) continue;
+        st->by_id.erase(rec.id_hash);
+        for (uint64_t sh : rec.sessions)
+            multimap_drop(st->by_session, sh, slots[i]);
+        if (rec.party_hash)
+            multimap_drop(st->by_party, rec.party_hash, slots[i]);
+        rec.occupied = false;
+        rec.sessions.clear();
+        --st->live;
+    }
+}
+
+int32_t ts_slot_of(void* h, uint64_t id_hash) {
+    Store* st = static_cast<Store*>(h);
+    auto it = st->by_id.find(id_hash);
+    return it == st->by_id.end() ? -1 : it->second;
+}
+
+int32_t ts_session_count(void* h, uint64_t session_hash) {
+    Store* st = static_cast<Store*>(h);
+    auto it = st->by_session.find(session_hash);
+    return it == st->by_session.end()
+               ? 0
+               : static_cast<int32_t>(it->second.size());
+}
+
+int32_t ts_party_count(void* h, uint64_t party_hash) {
+    Store* st = static_cast<Store*>(h);
+    auto it = st->by_party.find(party_hash);
+    return it == st->by_party.end() ? 0
+                                    : static_cast<int32_t>(it->second.size());
+}
+
+int32_t ts_session_slots(void* h, uint64_t session_hash, int32_t* out,
+                         int32_t cap) {
+    return copy_out(static_cast<Store*>(h)->by_session, session_hash, out,
+                    cap);
+}
+
+int32_t ts_party_slots(void* h, uint64_t party_hash, int32_t* out,
+                       int32_t cap) {
+    return copy_out(static_cast<Store*>(h)->by_party, party_hash, out, cap);
+}
+}

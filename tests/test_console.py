@@ -190,6 +190,7 @@ async def test_console_matchmaker_breadcrumbs():
     server = NakamaServer(config, quiet_logger())
     backend = TpuBackend(config.matchmaker, quiet_logger())
     server.matchmaker.backend = backend
+    backend.attach(server.matchmaker.store)
     await server.start()
     console = Console(server)
     try:

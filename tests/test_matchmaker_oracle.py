@@ -262,3 +262,39 @@ def test_expired_tickets_stay_passively_matchable():
     add(mm, mn=2, mx=3)
     mm.process()  # new active picks up the passive ticket on its last interval
     assert len(got) == 1
+
+
+def test_duplicate_removal_does_not_poison_allocator():
+    """Removing the same ticket id twice in one call must not double-free
+    the slot (round-3 review finding: a duplicated free-list entry made
+    every later add raise 'slot occupied' forever)."""
+    mm, _ = make_mm()
+    t1, _p = add(mm)
+    mm.remove([t1, t1])
+    assert len(mm) == 0
+    # The slot must be reusable exactly once per add from here on.
+    for _ in range(4):
+        add(mm)
+    assert len(mm) == 4
+
+
+def test_insert_tolerates_duplicate_extract():
+    """A re-delivered node-drain handover batch (same ticket id twice)
+    skips the duplicate instead of aborting the import."""
+    mm, _ = make_mm()
+    add(mm, mn=2, mx=3)
+    extracts = mm.extract()
+    mm2, _ = make_mm()
+    mm2.insert(extracts + extracts)  # replayed batch
+    assert len(mm2) == 1
+
+
+def test_active_gauge_tracks_expiry_and_removal():
+    mm, _ = make_mm(max_intervals=1)
+    t1, _p = add(mm, mn=2, mx=3)
+    add(mm, mn=3, mx=4)
+    assert len(mm.active) == 2
+    mm.process()  # both expire from active (max_intervals=1), stay live
+    assert len(mm.active) == 0 and len(mm) == 2
+    mm.remove([t1])
+    assert len(mm) == 1 and len(mm.active) == 0
