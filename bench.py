@@ -29,7 +29,7 @@ import time
 
 NS_POOL = int(os.environ.get("BENCH_POOL", 100_000))
 ORACLE_POOL = int(os.environ.get("BENCH_ORACLE_POOL", 2_000))
-INTERVALS = int(os.environ.get("BENCH_INTERVALS", 20))
+INTERVALS = int(os.environ.get("BENCH_INTERVALS", 30))
 WARMUP = int(os.environ.get("BENCH_WARMUP", 4))
 # Per-config sampling is kept lean (the refills between intervals dominate
 # bench wall-clock at 50k-160k pools); the north star gets the full >=16

@@ -462,7 +462,11 @@ class LocalMatchmaker:
         store.deactivate(expired_slots)
         if len(matched_slots):
             self.backend.on_remove_slots(matched_slots)
-            store.remove_slots(matched_slots)
+            objs = store.remove_slots(matched_slots)
+            if batch.offsets is not None:
+                # Columnar batch: its slots ARE matched_slots in order —
+                # reuse the parked refs as the delivery snapshot.
+                batch.bind_tickets(objs)
         store.reactivate(reactivate)
 
         if self.metrics is not None:

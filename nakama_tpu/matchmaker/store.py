@@ -127,11 +127,14 @@ class SlotStore:
         # reused slots (tpu.py).
         self.gen = np.zeros(capacity, dtype=np.int64)
         self.n_active = 0  # O(1) gauge (the masks are O(capacity) to sum)
-        self._free = list(range(capacity - 1, -1, -1))
+        # LIFO free stack (numpy: bulk push is one slice write); top at
+        # index _free_n-1, initialized so slot 0 pops first (density).
+        self._free = np.arange(capacity - 1, -1, -1, dtype=np.int32)
+        self._free_n = capacity
         try:
             from .. import native
 
-            self.maps = native.TickStore(capacity)
+            self.maps = native.TickStore(capacity, max_party_size)
         except Exception:
             self.maps = PyTickStore(capacity)
         self._graveyard: list[np.ndarray] = []
@@ -144,7 +147,7 @@ class SlotStore:
     def add(self, ticket: MatchmakerTicket, active: bool = True) -> int:
         """Assign a slot and register the ticket. Raises on capacity/dup;
         leaves no partial state behind on failure."""
-        if not self._free:
+        if self._free_n == 0:
             raise RuntimeError("matchmaker pool capacity exceeded")
         sessions = sorted(ticket.session_ids)
         stride = self.meta["session_hashes"].shape[1]
@@ -155,14 +158,14 @@ class SlotStore:
         sh = np.asarray(
             [_hash_id(s) for s in sessions], dtype=np.uint64
         )
-        slot = self._free[-1]
+        slot = int(self._free[self._free_n - 1])
         self.maps.add(
             slot,
             _hash_id(ticket.ticket),
             sh,
             _hash_id(ticket.party_id) if ticket.party_id else 0,
         )
-        self._free.pop()
+        self._free_n -= 1
         m = self.meta
         m["min_count"][slot] = ticket.min_count
         m["max_count"][slot] = ticket.max_count
@@ -186,22 +189,30 @@ class SlotStore:
         to the graveyard; `drain()` frees them off the critical path
         (`defer_free=False` skips the parking — small rollback paths).
 
+        Returns the parked object-ref array (ticket_at[slots]) so the
+        caller can reuse it (MatchBatch snapshot) without a second
+        O(entries) fancy index.
+
         `slots` must be duplicate-free: the interval path guarantees it by
         construction (matches are slot-disjoint); API paths dedupe in
         LocalMatchmaker._remove_slots. A duplicate here would double-free
         the slot into the free list and poison the allocator."""
         if len(slots) == 0:
-            return
+            return None
         slots = np.asarray(slots, dtype=np.int32)
         self.maps.remove_slots(slots)
+        objs = self.ticket_at[slots]
         if defer_free:
-            self._graveyard.append(self.ticket_at[slots])
+            self._graveyard.append(objs)
         self.ticket_at[slots] = None
         self.alive[slots] = False
         self.n_active -= int(self.active[slots].sum())
         self.active[slots] = False
         self.meta["session_counts"][slots] = 0
-        self._free.extend(slots.tolist())
+        n = len(slots)
+        self._free[self._free_n : self._free_n + n] = slots
+        self._free_n += n
+        return objs
 
     def deactivate(self, slots: np.ndarray):
         if len(slots) == 0:

@@ -68,6 +68,10 @@ from .types import MatchBatch, MatchmakerTicket
 
 _CQ_MISS = object()  # cache-miss sentinel (None is a valid cached value)
 
+# assembler.cpp mirrors these should-clause opcodes; a drift here would
+# silently corrupt in-assembly validation.
+assert (SOP_UNUSED, SOP_ALL, SOP_NUM_RANGE, SOP_STR_EQ) == (0, 1, 2, 3)
+
 
 def _pow2_blocks(blocks: int) -> int:
     """Smallest power of two >= blocks (>=1)."""
@@ -553,7 +557,14 @@ class TpuBackend:
             with span(crumb, "collect_s"):
                 cand_np = self._collect(w_pending, w_n)
             with span(crumb, "assemble_s"):
-                n_matches, offsets, flat = native.assemble_arrays(
+                # Exact query validation runs INSIDE the assembler (f64
+                # mirrors, struct Exact): an imprecision-admitted candidate
+                # is skipped there and assembly continues with the next
+                # hit — matching the reference, whose index search never
+                # returns non-matching hits. Only matches flagged
+                # needs_host (host-only member under mutual validation)
+                # fall back to the AST check below.
+                n_matches, offsets, flat, needs_host = native.assemble_arrays(
                     w_slots,
                     w_last,
                     cand_np,
@@ -565,10 +576,12 @@ class TpuBackend:
                     created=meta["created"],
                     session_hashes=meta["session_hashes"],
                     session_counts=meta["session_counts"],
+                    exact=self.exact,
+                    rev=rev_precision,
                 )
             with span(crumb, "validate_s"):
-                ok = self._validate_bulk(
-                    n_matches, offsets, flat, rev_precision
+                ok = self._validate_flagged(
+                    n_matches, offsets, flat, needs_host, rev_precision
                 )
             with span(crumb, "accept_s"):
                 total = int(offsets[n_matches])
@@ -613,12 +626,9 @@ class TpuBackend:
         else:
             matched_slots = np.zeros(0, dtype=np.int32)
             offsets_out = np.zeros(1, dtype=np.int64)
-        batch = MatchBatch(
-            offsets_out,
-            matched_slots,
-            self.store.ticket_at,
-            counts=meta["count"],
-        )
+        # Ticket snapshot deferred: LocalMatchmaker binds the removal
+        # path's parked object array (same slots, same order).
+        batch = MatchBatch(offsets_out, matched_slots, counts=meta["count"])
 
         if react_parts:
             reactivate = np.unique(np.concatenate(react_parts))
@@ -847,104 +857,39 @@ class TpuBackend:
 
     # ----------------------------------------------------------- validation
 
-    def _pair_accepts64(
-        self, q_slots: np.ndarray, v_slots: np.ndarray
-    ) -> np.ndarray:
-        """Exact vectorized `query(q) accepts values(v)` per pair."""
-        ex = self.exact
-        lo = ex["q_lo"][q_slots]
-        hi = ex["q_hi"][q_slots]
-        v = ex["v_num"][v_slots]
-        unconstrained = np.isneginf(lo) & np.isposinf(hi)
-        ok = np.all(((v >= lo) & (v <= hi)) | unconstrained, axis=1)
-        in_forb = (v >= ex["q_flo"][q_slots]) & (v <= ex["q_fhi"][q_slots])
-        ok &= ~np.any(in_forb, axis=1)
-        sv = ex["v_str"][v_slots]
-        req = ex["q_req"][q_slots]
-        forb = ex["q_forb"][q_slots]
-        ok &= np.all(
-            ((req == 0) | (sv == req)) & ((forb == 0) | (sv != forb)), axis=1
-        )
-        gate = (~ex["q_has_must"][q_slots]) & ex["q_has_should"][q_slots]
-        if gate.any():
-            qs = q_slots[gate]
-            vs = v_slots[gate]
-            op = ex["q_sh_op"][qs]
-            fld = ex["q_sh_fld"][qs]
-            rows = np.arange(len(qs))[:, None]
-            # fld indexes numeric fields for SOP_NUM_RANGE and string fields
-            # for SOP_STR_EQ; the widths differ, so clamp each lookup to its
-            # own array (the op select below discards the clamped garbage) —
-            # mirrors jnp.take's clamping in the device kernel.
-            nv = ex["v_num"][vs][rows, np.minimum(fld, self.fn - 1)]
-            s2 = ex["v_str"][vs][rows, np.minimum(fld, self.fs - 1)]
-            term = ex["q_sh_term"][qs]
-            sat = np.where(
-                op == SOP_NUM_RANGE,
-                (nv >= ex["q_sh_lo"][qs]) & (nv <= ex["q_sh_hi"][qs]),
-                np.where(
-                    op == SOP_STR_EQ,
-                    (s2 == term) & (term != 0),
-                    op == SOP_ALL,
-                ),
-            )
-            ok[gate] &= np.any(sat & (op != SOP_UNUSED), axis=1)
-        return ok
-
-    def _validate_bulk(
+    def _validate_flagged(
         self,
         n_matches: int,
         offsets: np.ndarray,
         flat: np.ndarray,
+        needs_host: np.ndarray,
         rev: bool,
     ) -> np.ndarray:
-        """Validity of each assembled match: the searcher (last slot) must
-        accept every member — every ordered pair must be mutual under
-        rev_precision (reference validateMatch, server/matchmaker.go:
-        1042-1068). Vectorized over all pairs of all matches."""
-        if n_matches == 0:
-            return np.zeros(0, dtype=bool)
-        flat = flat[: offsets[n_matches]]
-        sizes = offsets[1 : n_matches + 1] - offsets[:n_matches]
-        mid = np.repeat(np.arange(n_matches), sizes)
-        searcher_pos = offsets[1 : n_matches + 1] - 1
-        is_searcher = np.zeros(len(flat), dtype=bool)
-        is_searcher[searcher_pos] = True
+        """AST-validate only the matches the assembler could not check
+        exactly (a member without an exact query mirror under mutual
+        validation — host-only queries; reference validateMatch,
+        server/matchmaker.go:1042-1068). Everything else was validated
+        in-assembly."""
         ok = np.ones(n_matches, dtype=bool)
-
-        if not rev:
-            q = flat[searcher_pos][mid[~is_searcher]]
-            v = flat[~is_searcher]
-            pair_ok = self._pair_accepts64(q, v)
-            np.logical_and.at(ok, mid[~is_searcher], pair_ok)
+        idx = np.nonzero(needs_host[:n_matches])[0]
+        if not len(idx):
             return ok
-
-        # Mutual: all ordered pairs. Matches containing host-only members
-        # (no exact query mirror) fall back to the AST evaluator.
-        exact_ok = self.exact["q_exact_ok"][flat]
-        fallback = np.zeros(n_matches, dtype=bool)
-        np.logical_or.at(fallback, mid, ~exact_ok)
-        ms = int(sizes.max())
-        padded = np.full((n_matches, ms), -1, dtype=flat.dtype)
-        padded[mid, np.concatenate([np.arange(s) for s in sizes])] = flat
-        qi = np.repeat(padded[:, :, None], ms, axis=2)
-        vj = np.repeat(padded[:, None, :], ms, axis=1)
-        valid_pair = (qi >= 0) & (vj >= 0) & (qi != vj)
-        fb_rows = fallback[:, None, None] | ~valid_pair
-        pair_ok = np.ones((n_matches, ms, ms), dtype=bool)
-        sel = ~fb_rows
-        if sel.any():
-            pair_ok[sel] = self._pair_accepts64(qi[sel], vj[sel])
-        ok = pair_ok.all(axis=(1, 2))
-        for i in np.nonzero(fallback)[0]:
+        ticket_at = self.store.ticket_at
+        for i in idx:
             tickets = [
-                self.store.ticket_at[s]
-                for s in flat[offsets[i] : offsets[i + 1]]
+                ticket_at[s] for s in flat[offsets[i] : offsets[i + 1]]
             ]
-            ok[i] = all(t is not None for t in tickets) and all(
-                _mutual(a, b)
-                for a in tickets
-                for b in tickets
-                if a is not b
-            )
+            if any(t is None for t in tickets):
+                ok[i] = False
+                continue
+            if rev:
+                ok[i] = all(
+                    _mutual(a, b)
+                    for a in tickets
+                    for b in tickets
+                    if a is not b
+                )
+            else:
+                searcher = tickets[-1]
+                ok[i] = all(_mutual(searcher, m) for m in tickets[:-1])
         return ok

@@ -112,16 +112,23 @@ class MatchBatch:
 
     __slots__ = ("offsets", "slots", "_tickets", "_counts", "_cache")
 
-    def __init__(self, offsets, slots, ticket_at, counts=None):
+    def __init__(self, offsets, slots, ticket_at=None, counts=None):
         self.offsets = offsets  # i32/i64 [n_matches + 1]
         self.slots = slots  # i32 [total ticket slots]
-        # Snapshot object refs + entry counts NOW (two vectorized fancy
-        # indexes): matched slots are store-removed right after delivery,
-        # so slot-indexed lookups would read None by the time a lazy
-        # consumer materializes entries.
+        # Object refs + entry counts are SNAPSHOT, not slot-indexed live:
+        # matched slots are store-removed right after delivery, so lazy
+        # consumers would read None otherwise. The ticket snapshot may be
+        # deferred (ticket_at=None) and bound via bind_tickets() with the
+        # removal path's parked array, saving a duplicate O(entries)
+        # object fancy-index per interval.
         self._tickets = None if ticket_at is None else ticket_at[slots]
         self._counts = None if counts is None else counts[slots]
         self._cache: dict[int, list[MatchmakerEntry]] = {}
+
+    def bind_tickets(self, tickets_arr):
+        """Late-bind the ticket snapshot (aligned with `slots`)."""
+        if self._tickets is None:
+            self._tickets = tickets_arr
 
     @classmethod
     def from_lists(cls, matched: list[list["MatchmakerEntry"]]):

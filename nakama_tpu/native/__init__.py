@@ -56,7 +56,7 @@ def load() -> ctypes.CDLL:
         lib = ctypes.CDLL(_LIB_PATH)
         lib.mm_assemble.restype = ctypes.c_int32
         lib.ts_create.restype = ctypes.c_void_p
-        lib.ts_create.argtypes = [ctypes.c_int32]
+        lib.ts_create.argtypes = [ctypes.c_int32, ctypes.c_int32]
         lib.ts_destroy.argtypes = [ctypes.c_void_p]
         lib.ts_len.restype = ctypes.c_int64
         lib.ts_len.argtypes = [ctypes.c_void_p]
@@ -87,9 +87,9 @@ class TickStore:
     dict churn of matched-ticket unregistration (reference maintains these
     maps in Go, server/matchmaker.go:171-214)."""
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, stride: int = 8):
         self._lib = load()
-        self._h = ctypes.c_void_p(self._lib.ts_create(capacity))
+        self._h = ctypes.c_void_p(self._lib.ts_create(capacity, stride))
 
     def __del__(self):
         h, self._h = self._h, None
@@ -164,20 +164,6 @@ def _ptr(arr: np.ndarray, dtype) -> ctypes.c_void_p:
     return arr.ctypes.data_as(ctypes.c_void_p)
 
 
-def assemble(
-    active_slots: np.ndarray,
-    last_interval: np.ndarray,
-    cand: np.ndarray,
-    **kw,
-) -> list[list[int]]:
-    """Run the native greedy assembler; returns matches as slot lists, the
-    active ticket's slot last in each."""
-    n, offsets, slots = assemble_arrays(active_slots, last_interval, cand, **kw)
-    return [
-        slots[offsets[i] : offsets[i + 1]].tolist() for i in range(n)
-    ]
-
-
 def assemble_arrays(
     active_slots: np.ndarray,  # i32 [A]
     last_interval: np.ndarray,  # u8 [A]
@@ -191,14 +177,22 @@ def assemble_arrays(
     created: np.ndarray,  # i64 [slots]
     session_hashes: np.ndarray,  # u64 [slots, stride]
     session_counts: np.ndarray,  # i32 [slots]
-) -> tuple[int, np.ndarray, np.ndarray]:
-    """Like `assemble` but returns (n_matches, offsets i32 [n+1], flat slot
-    array) without materializing Python lists — the bulk-validation path
-    consumes the arrays directly."""
+    exact: dict,  # TpuBackend.exact mirror arrays (f64/i64/bool by slot)
+    rev: bool,
+) -> tuple[int, np.ndarray, np.ndarray, np.ndarray]:
+    """Greedy assembly with in-loop exact match validation; returns
+    (n_matches, offsets i32 [n+1], flat slot array, needs_host u8 [n]) —
+    needs_host marks matches containing members without exact query
+    mirrors under mutual validation (caller AST-validates those)."""
     lib = load()
     a = len(active_slots)
     if a == 0:
-        return 0, np.zeros(1, dtype=np.int32), np.zeros(0, dtype=np.int32)
+        return (
+            0,
+            np.zeros(1, dtype=np.int32),
+            np.zeros(0, dtype=np.int32),
+            np.zeros(0, dtype=np.uint8),
+        )
     k = cand.shape[1] if cand.ndim == 2 else 0
     n_slots = len(min_count)
     stride = session_hashes.shape[1]
@@ -206,6 +200,10 @@ def assemble_arrays(
     max_slots_out = int(np.sum(count[active_slots])) + int(cand.size) * 2 + 64
     out_offsets = np.zeros(max_matches + 1, dtype=np.int32)
     out_slots = np.zeros(max_slots_out, dtype=np.int32)
+    out_needs_host = np.zeros(max_matches, dtype=np.uint8)
+    fn = exact["v_num"].shape[1]
+    fs = exact["v_str"].shape[1]
+    n_should = exact["q_sh_op"].shape[1]
 
     n = lib.mm_assemble(
         ctypes.c_int32(a),
@@ -223,11 +221,32 @@ def assemble_arrays(
         _ptr(session_counts, np.int32),
         ctypes.c_int32(stride),
         ctypes.c_int32(n_slots),
+        _ptr(exact["q_lo"], np.float64),
+        _ptr(exact["q_hi"], np.float64),
+        _ptr(exact["q_flo"], np.float64),
+        _ptr(exact["q_fhi"], np.float64),
+        _ptr(exact["v_num"], np.float64),
+        _ptr(exact["q_req"], np.int64),
+        _ptr(exact["q_forb"], np.int64),
+        _ptr(exact["v_str"], np.int64),
+        _ptr(exact["q_sh_op"], np.int32),
+        _ptr(exact["q_sh_fld"], np.int32),
+        _ptr(exact["q_sh_lo"], np.float64),
+        _ptr(exact["q_sh_hi"], np.float64),
+        _ptr(exact["q_sh_term"], np.int64),
+        _ptr(exact["q_has_must"].view(np.uint8), np.uint8),
+        _ptr(exact["q_has_should"].view(np.uint8), np.uint8),
+        _ptr(exact["q_exact_ok"].view(np.uint8), np.uint8),
+        ctypes.c_int32(fn),
+        ctypes.c_int32(fs),
+        ctypes.c_int32(n_should),
+        ctypes.c_int32(1 if rev else 0),
         _ptr(out_offsets, np.int32),
         ctypes.c_int32(max_matches),
         _ptr(out_slots, np.int32),
         ctypes.c_int32(max_slots_out),
+        _ptr(out_needs_host, np.uint8),
     )
     if n < 0:
         raise RuntimeError("assembler output buffer overflow")
-    return n, out_offsets, out_slots
+    return n, out_offsets, out_slots, out_needs_host
