@@ -106,6 +106,13 @@ class MatchmakerConfig:
     # cannot go stale; removed tickets are filtered at collection. Adds one
     # interval of matching latency; off by default.
     interval_pipelining: bool = False
+    # Per-interval cap on host-only actives run through the CPU oracle
+    # fallback (exotic queries the device kernel can't express). The
+    # fallback is O(actives x pool) Python; without a cap a hostile or
+    # misconfigured client drags every interval back to oracle speed.
+    # Overflow defers to the next interval, oldest-first (the reference's
+    # own time-budget pattern: server/matchmaker_process.go:33-46).
+    host_budget_per_interval: int = 512
 
 
 @dataclass

@@ -54,6 +54,14 @@ PRIO_MAX = 8191  # 13-bit priority
 JITTER_AMP = 256  # selection-jitter range (stays below 1 emb-score unit)
 PACKED_NONE = -(2**31)  # plain int: pallas kernels must not capture arrays
 
+# Every pool field the row (query) side of the kernels reads.
+ROWQ_KEYS = (
+    "n_lo", "n_hi", "n_flo", "n_fhi", "s_req", "s_forb",
+    "min_count", "max_count", "pool_id", "flags", "party",
+    "num", "str", "emb", "created",
+    "sh_op", "sh_fld", "sh_lo", "sh_hi", "sh_term", "sh_boost",
+)
+
 
 def encoding_dims(fn: int, fs: int) -> int:
     return fn * NUM_BUCKETS + fs * STR_BUCKETS + POOL_BUCKETS
@@ -167,6 +175,7 @@ def _stage1_kernel(
     uq_ref,
     vv_ref,
     col_mix_ref,
+    col_gidx_ref,
     row_mix_ref,
     row_slot_ref,
     ue_ref,
@@ -220,7 +229,12 @@ def _stage1_kernel(
         prio = jnp.clip(prio + bump, 0, PRIO_MAX)
 
     j = pl.program_id(1)
-    col = j * bn + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    # GLOBAL column ids come in as data (not derived from the grid
+    # position): under the mesh each device's grid walks only its column
+    # shard, but the packed winner words and the self-exclusion compare
+    # must use pool-global slot ids so the cross-device merge and stage-2
+    # gather see one coherent index space.
+    col = col_gidx_ref[:]  # [1, bn] -> broadcasts against [bm, bn]
     not_self = col != row_slot_ref[:]
     win = jnp.where(
         elig & not_self, (prio << COL_BITS) | col, jnp.int32(PACKED_NONE)
@@ -247,6 +261,73 @@ def _stage1_kernel(
             win = jnp.where(win == cur, jnp.int32(PACKED_NONE), win)
         acc = jnp.where(lane == j * m + t, cur, acc)
     out_ref[:] = acc
+
+
+def _stage1_call(
+    uq, vv, col_mix, col_gidx, row_mix, row_slot, ue, ve, uv, vq,
+    *,
+    fn: int,
+    fs: int,
+    m: int,
+    bm: int,
+    bn: int,
+    with_embedding: bool,
+    rev: bool,
+    emb_scale: float,
+    interpret: bool,
+    vma=None,
+):
+    """One pallas stage-1 launch over the column range held in `vv`
+    (the whole pool unsharded; one device's shard under the mesh —
+    `vma` names the mesh axes the output varies over in that case).
+    Returns packed per-block winners [a_pad, out_w]."""
+    a_pad = uq.shape[0]
+    n = vv.shape[0]
+    d = encoding_dims(fn, fs)
+    n_blocks = n // bn
+    de = ue.shape[1]
+    dq = uv.shape[1]
+    out_w = -(-(n_blocks * m) // 128) * 128  # lane-dim must be 128-aligned
+    kernel = functools.partial(
+        _stage1_kernel,
+        f_tot=float(fn + fs + 1),
+        bn=bn,
+        m=m,
+        out_w=out_w,
+        with_embedding=with_embedding,
+        rev=rev,
+        emb_scale=emb_scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(a_pad // bm, n_blocks),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, de), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, de), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, dq), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, dq), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (bm, out_w), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((a_pad, out_w), jnp.int32)
+            if vma is None
+            else jax.ShapeDtypeStruct((a_pad, out_w), jnp.int32, vma=vma)
+        ),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * a_pad * n * (d + (de if with_embedding else 0)),
+            bytes_accessed=(a_pad + n) * d * 2 + a_pad * n_blocks * 4,
+            transcendentals=0,
+        ),
+    )(uq, vv, col_mix, col_gidx, row_mix, row_slot, ue, ve, uv, vq)
 
 
 @functools.partial(
@@ -289,21 +370,14 @@ def topk_candidates_big(
 
     pool_n = {key: v[:n] for key, v in pool.items()}
     safe = jnp.maximum(active_slots, 0)
-    rowq = {
-        key: pool_n[key][safe]
-        for key in (
-            "n_lo", "n_hi", "n_flo", "n_fhi", "s_req", "s_forb",
-            "min_count", "max_count", "pool_id", "flags", "party",
-            "num", "str", "emb", "created",
-            "sh_op", "sh_fld", "sh_lo", "sh_hi", "sh_term", "sh_boost",
-        )
-    }
+    rowq = {key: pool_n[key][safe] for key in ROWQ_KEYS}
 
     vv = _value_vectors(pool_n, n, fn, fs, grid_lo, grid_inv)
     uq = _query_vectors(rowq, fn, fs, grid_lo, grid_inv)
     uq = uq * (active_slots >= 0).astype(jnp.bfloat16)[:, None]
 
     col_idx = jnp.arange(n, dtype=jnp.int32)
+    col_gidx = col_idx[None]
     col_mix = _mix(col_idx + 1)[None]
     row_mix = _mix(jnp.arange(a_pad, dtype=jnp.int32) * 7919 + 13)[:, None]
     row_slot = safe[:, None]
@@ -323,45 +397,182 @@ def topk_candidates_big(
         uv = jnp.zeros((a_pad, 8), jnp.bfloat16)
         vq = jnp.zeros((n, 8), jnp.bfloat16)
 
-    de = ue.shape[1]
-    dq = uv.shape[1]
-    out_w = -(-(n_blocks * m) // 128) * 128  # lane-dim must be 128-aligned
-    kernel = functools.partial(
-        _stage1_kernel,
-        f_tot=float(fn + fs + 1),
-        bn=bn,
+    winners = _stage1_call(
+        uq, vv, col_mix, col_gidx, row_mix, row_slot, ue, ve, uv, vq,
+        fn=fn,
+        fs=fs,
         m=m,
-        out_w=out_w,
+        bm=bm,
+        bn=bn,
         with_embedding=with_embedding,
         rev=rev,
         emb_scale=emb_scale,
-    )
-    winners = pl.pallas_call(
-        kernel,
-        grid=(a_pad // bm, n_blocks),
-        in_specs=[
-            pl.BlockSpec((bm, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bn, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bn), lambda i, j: (0, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, de), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bn, de), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, dq), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bn, dq), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (bm, out_w), lambda i, j: (i, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((a_pad, out_w), jnp.int32),
         interpret=interpret,
-        cost_estimate=pl.CostEstimate(
-            flops=2 * a_pad * n * (d + (de if with_embedding else 0)),
-            bytes_accessed=(a_pad + n) * d * 2 + a_pad * n_blocks * 4,
-            transcendentals=0,
-        ),
-    )(uq, vv, col_mix, row_mix, row_slot, ue, ve, uv, vq)
+    )
 
+    return _stage2(
+        pool_n,
+        rowq,
+        active_slots,
+        winners,
+        k=k,
+        rev=rev,
+        with_should=with_should,
+        with_embedding=with_embedding,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "axis", "fn", "fs", "k", "rev", "with_should",
+        "with_embedding", "bm", "bn", "interpret", "emb_scale",
+    ),
+)
+def topk_candidates_big_sharded(
+    pool: dict,  # [N, ...] arrays sharded along their slot axis
+    active_slots: jnp.ndarray,  # i32 [A_pad] padded with -1
+    grid_lo: jnp.ndarray,  # f32 [fn]
+    grid_inv: jnp.ndarray,  # f32 [fn]
+    *,
+    mesh,
+    axis: str = "pool",
+    fn: int,
+    fs: int,
+    k: int,
+    rev: bool,
+    with_should: bool,
+    with_embedding: bool,
+    bm: int = 1024,
+    bn: int = 1024,
+    interpret: bool = False,
+    emb_scale: float = 256.0,
+):
+    """Mesh-sharded two-stage top-k (VERDICT r2 #2): stage 1 runs the MXU
+    pallas kernel per device over ITS column shard of the pool, the packed
+    per-block winners concatenate across devices (GSPMD inserts the ICI
+    all_gather — winners are A_pad x out_w i32, orders of magnitude
+    smaller than the score matrix), and ONE exact stage-2 re-rank runs on
+    the merged set. Because the per-block winner count `m` derives from
+    the GLOBAL block count and the packed words carry pool-global column
+    ids, the merged winner SET is identical to the unsharded kernel's —
+    sharding changes where the matmuls run, not what they select.
+
+    Reference seam this replaces: the `node` string threaded through
+    server/matchmaker.go:169-183 (cross-node matching absent in OSS)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = pool["num"].shape[0]
+    n_dev = mesh.shape[axis]
+    n_local = n // n_dev
+    assert n_local % bn == 0, (n_local, bn)
+    assert n <= MAX_COLS
+    a_pad = active_slots.shape[0]
+    n_blocks_global = n // bn
+    m = max(1, -(-2 * k // n_blocks_global))
+
+    # Row (query) side: gathered across shards by GSPMD, then replicated —
+    # every device scores ALL active rows against its column shard.
+    safe = jnp.maximum(active_slots, 0)
+    rowq = {key: pool[key][safe] for key in ROWQ_KEYS}
+    rep = NamedSharding(mesh, P())
+    rowq = {
+        key: jax.lax.with_sharding_constraint(v, rep)
+        for key, v in rowq.items()
+    }
+    uq = _query_vectors(rowq, fn, fs, grid_lo, grid_inv)
+    uq = uq * (active_slots >= 0).astype(jnp.bfloat16)[:, None]
+    row_mix = _mix(jnp.arange(a_pad, dtype=jnp.int32) * 7919 + 13)[:, None]
+    row_slot = safe[:, None]
+    if with_embedding:
+        ue = rowq["emb"].astype(jnp.bfloat16)
+    else:
+        ue = jnp.zeros((a_pad, 8), jnp.bfloat16)
+    if rev:
+        # Value vectors of the active rows == vv[safe] computed locally
+        # from the gathered row data (same expression, no pool gather).
+        uv = _value_vectors(rowq, a_pad, fn, fs, grid_lo, grid_inv)
+    else:
+        uv = jnp.zeros((a_pad, 8), jnp.bfloat16)
+
+    # Column side: per-shard constants carrying GLOBAL column ids.
+    col_idx = jnp.arange(n, dtype=jnp.int32)
+    col_gidx = col_idx[None]
+    col_mix = _mix(col_idx + 1)[None]
+
+    col_keys = ("num", "str", "pool_id", "flags") + (
+        ("n_lo", "n_hi", "n_flo", "n_fhi", "s_req", "min_count",
+         "max_count") if rev else ()
+    )
+    pool_cols = {key: pool[key] for key in sorted(set(col_keys))}
+
+    def per_device(pool_local, col_mix_l, col_gidx_l, uq, row_mix,
+                   row_slot, ue, uv, grid_lo, grid_inv):
+        # Replicated row-side inputs meet device-varying column data in
+        # the kernel: mark them varying explicitly (vma typing).
+        (uq, row_mix, row_slot, ue, uv, grid_lo, grid_inv) = jax.lax.pcast(
+            (uq, row_mix, row_slot, ue, uv, grid_lo, grid_inv), axis,
+            to="varying",
+        )
+        nloc = pool_local["num"].shape[0]
+        vv_l = _value_vectors(pool_local, nloc, fn, fs, grid_lo, grid_inv)
+        if rev:
+            vq_l = _query_vectors(
+                pool_local, fn, fs, grid_lo, grid_inv, with_counts=False
+            )
+        else:
+            vq_l = jax.lax.pcast(
+                jnp.zeros((nloc, 8), jnp.bfloat16), axis, to="varying"
+            )
+        if with_embedding:
+            ve_l = pool_local["emb"].astype(jnp.bfloat16)
+        else:
+            ve_l = jax.lax.pcast(
+                jnp.zeros((nloc, 8), jnp.bfloat16), axis, to="varying"
+            )
+        win = _stage1_call(
+            uq, vv_l, col_mix_l, col_gidx_l, row_mix, row_slot, ue,
+            ve_l, uv, vq_l,
+            fn=fn,
+            fs=fs,
+            m=m,
+            bm=bm,
+            bn=bn,
+            with_embedding=with_embedding,
+            rev=rev,
+            emb_scale=emb_scale,
+            interpret=interpret,
+            vma=frozenset({axis}),
+        )
+        # Leading shard axis for the caller-side concat (same pattern as
+        # parallel/mesh.py sharded_topk_rows).
+        return win[None]
+
+    if with_embedding:
+        pool_cols["emb"] = pool["emb"]
+    winners = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(
+            P(axis), P(None, axis), P(None, axis), P(), P(), P(), P(),
+            P(), P(), P(),
+        ),
+        out_specs=P(axis),
+        # Pallas interpret mode (CPU tests) lifts kernel-body scalar
+        # constants with empty vma and the checker rejects the mix — the
+        # error text itself prescribes check_vma=False as the workaround.
+        # Real Mosaic lowering (TPU) keeps the check on.
+        check_vma=not interpret,
+    )(
+        pool_cols, col_mix, col_gidx, uq, row_mix, row_slot, ue, uv,
+        grid_lo, grid_inv,
+    )  # [D, a_pad, out_w_local], sharded on dim 0
+    # The merge: concat per-shard winner stripes along the lane axis.
+    # GSPMD inserts the all_gather over ICI here; stage 2's top_k then
+    # operates on the identical winner SET the unsharded kernel produces.
+    winners = jnp.moveaxis(winners, 0, 1).reshape(a_pad, -1)
+
+    pool_n = {key: v for key, v in pool.items()}
     return _stage2(
         pool_n,
         rowq,

@@ -146,6 +146,15 @@ class TpuBackend:
                     "pool_capacity must split into col_block-sized shards "
                     f"across {n_dev} devices"
                 )
+            if (
+                config.big_pool_threshold <= cap
+                and (cap // n_dev) % self.big_col_block
+            ):
+                raise ValueError(
+                    "pool_capacity must split into big_col_block-sized "
+                    f"shards across {n_dev} devices for the sharded MXU "
+                    "kernel (or raise big_pool_threshold above capacity)"
+                )
             from ..parallel.mesh import make_mesh
 
             self._mesh = make_mesh(n_dev)
@@ -433,10 +442,33 @@ class TpuBackend:
             "host_actives": n_host,
         }
         span = self.tracing.span
+        deferred_slots = None
         if n_host:
             host_slots = active_slots[host_sel]
             device_slots = active_slots[~host_sel]
             device_last = last_interval[~host_sel]
+            budget = self.config.host_budget_per_interval
+            if budget > 0 and n_host > budget:
+                # Cap the O(actives x pool) oracle fallback per interval:
+                # oldest tickets go first, the rest wait for the next
+                # interval (they stay active; only their matching is
+                # deferred, never dropped).
+                order = np.argsort(
+                    self.meta["created"][host_slots], kind="stable"
+                )
+                deferred_slots = host_slots[order[budget:]]
+                host_slots = host_slots[order[:budget]]
+                deferred = n_host - budget
+                crumb["host_deferred"] = deferred
+                if self.metrics is not None:
+                    self.metrics.counter_add(
+                        "matchmaker_host_only_deferred", deferred
+                    )
+                self.logger.warn(
+                    "host-only fallback over budget; deferring",
+                    budget=budget,
+                    deferred=deferred,
+                )
         else:
             host_slots = None
             device_slots = active_slots
@@ -510,8 +542,13 @@ class TpuBackend:
         size_parts: list[np.ndarray] = []
         # Slots whose assembled match was dropped after they may already
         # have gone inactive (pipelined collection lags dispatch by one
-        # interval): give them another active interval.
+        # interval): give them another active interval. Budget-deferred
+        # host-only slots likewise — the caller's expiry pass deactivates
+        # min==max actives after ONE processing attempt, and a deferred
+        # slot hasn't had its attempt yet.
         react_parts: list[np.ndarray] = []
+        if deferred_slots is not None and len(deferred_slots):
+            react_parts.append(deferred_slots.astype(np.int32))
 
         if host_slots is not None:
             # Runs while the device computes and the candidate lists
@@ -685,12 +722,7 @@ class TpuBackend:
                 a_pad, n_cols, rev, with_should, with_embedding, bm, bn
             )
 
-            width = self._grid_hi - self._grid_lo
-            ok = np.isfinite(width) & (width >= 0)
-            grid_lo = np.where(ok, self._grid_lo, 0.0).astype(np.float32)
-            grid_inv = (
-                1.0 / np.maximum(np.where(ok, width, 1.0), 1e-30)
-            ).astype(np.float32)
+            grid_lo, grid_inv = self._grid_params()
             cand_dev = topk_candidates_big(
                 self.pool.device,
                 pad_to(slots, a_pad, -1),
@@ -708,23 +740,7 @@ class TpuBackend:
                 interpret=self._interpret,
                 emb_scale=self.config.emb_score_scale,
             )
-            # Pull the result to host on a worker thread: the D2H transfer
-            # (and the wait for the async compute) runs during the gap to
-            # the next interval, not on the interval critical path.
-            # copy_to_host_async alone proved unreliable here — issued
-            # before the computation commits, some plugins drop it and the
-            # collect-side np.asarray pays the full transfer.
-            holder: dict = {}
-
-            def _fetch(dev=cand_dev, out=holder):
-                try:
-                    out["np"] = np.asarray(dev)
-                except Exception as e:  # surfaced at collect
-                    out["err"] = e
-
-            thread = threading.Thread(target=_fetch, daemon=True)
-            thread.start()
-            return ("big", cand_dev, holder, thread)
+            return self._bg_fetch(cand_dev)
 
         # Small-pool exact path (unchanged round-1 kernel).
         n_blocks = -(-len(slots) // self.row_block)
@@ -748,17 +764,74 @@ class TpuBackend:
         )
         return ("small", scores, cand)
 
+    def _grid_params(self):
+        """Bucket-grid (lo, 1/width) per numeric field for the big kernel."""
+        width = self._grid_hi - self._grid_lo
+        ok = np.isfinite(width) & (width >= 0)
+        grid_lo = np.where(ok, self._grid_lo, 0.0).astype(np.float32)
+        grid_inv = (
+            1.0 / np.maximum(np.where(ok, width, 1.0), 1e-30)
+        ).astype(np.float32)
+        return grid_lo, grid_inv
+
+    def _bg_fetch(self, cand_dev):
+        """Pull the result to host on a worker thread: the D2H transfer
+        (and the wait for the async compute) runs during the gap to
+        the next interval, not on the interval critical path.
+        copy_to_host_async alone proved unreliable here — issued
+        before the computation commits, some plugins drop it and the
+        collect-side np.asarray pays the full transfer."""
+        holder: dict = {}
+
+        def _fetch(dev=cand_dev, out=holder):
+            try:
+                out["np"] = np.asarray(dev)
+            except Exception as e:  # surfaced at collect
+                out["err"] = e
+
+        thread = threading.Thread(target=_fetch, daemon=True)
+        thread.start()
+        return ("big", cand_dev, holder, thread)
+
     def _dispatch_sharded(
         self, slots: np.ndarray, rev: bool, with_should: bool,
         with_embedding: bool,
     ):
-        """Multi-device interval: every device scores all active rows
-        against ITS column shard of the pool, partial top-Ks merge over
-        ICI (parallel/mesh.py; SURVEY §2.8). Returns the small-path
-        pending shape so collection/assembly are shared."""
+        """Multi-device interval (SURVEY §2.8; parallel/mesh.py +
+        device2.topk_candidates_big_sharded): every device scores all
+        active rows against ITS column shard of the pool, partial
+        winners merge over ICI. Large pools take the sharded two-stage
+        MXU kernel (VERDICT r2 #2); small pools keep the exact
+        blockwise scan. Returns the shared pending shapes so
+        collection/assembly are common."""
         import jax.numpy as jnp
 
         from ..parallel.mesh import sharded_topk_rows
+
+        if self.pool.high_water >= self.config.big_pool_threshold:
+            from .device2 import topk_candidates_big_sharded
+
+            bm, bn = self.big_row_block, self.big_col_block
+            a_pad = _pow2_blocks(-(-len(slots) // bm)) * bm
+            grid_lo, grid_inv = self._grid_params()
+            cand_dev = topk_candidates_big_sharded(
+                self.pool.device,
+                pad_to(slots, a_pad, -1),
+                grid_lo,
+                grid_inv,
+                mesh=self._mesh,
+                fn=self.fn,
+                fs=self.fs,
+                k=self.k,
+                rev=rev,
+                with_should=with_should,
+                with_embedding=with_embedding,
+                bm=bm,
+                bn=bn,
+                interpret=self._interpret,
+                emb_scale=self.config.emb_score_scale,
+            )
+            return self._bg_fetch(cand_dev)
 
         br = self.row_block
         n_blocks = -(-len(slots) // br)

