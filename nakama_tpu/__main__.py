@@ -8,10 +8,10 @@ import sys
 
 
 def _migrate(argv: list[str]) -> int:
-    """`migrate up|status` against the configured database (reference
-    migrate/migrate.go CLI; down-migrations are not supported by design —
-    the embedded engine is forward-only, matching sql-migrate's safe
-    default posture)."""
+    """`migrate up|down|redo|status` against the configured database
+    (reference migrate/migrate.go:104-111 CLI). `down`/`redo` revert the
+    newest applied migration (downs are derived from the embedded up
+    statements — storage/migrations.py down_statements)."""
     from .config import parse_args
     from .storage.db import Database, migrate_status
 
@@ -24,6 +24,14 @@ def _migrate(argv: list[str]) -> int:
 
         if sub == "up":
             await db.connect()  # connect applies pending migrations
+        elif sub in ("down", "redo"):
+            await db.connect(migrate=False)
+            reverted = await db.migrate_down(1)
+            for name in reverted:
+                print(f"reverted {name}")
+            if sub == "redo":
+                for name in await db.migrate():
+                    print(f"re-applied {name}")
         elif sub == "status":
             # Status is read-only: connect WITHOUT applying, then report
             # pending entries from the embedded migration list.

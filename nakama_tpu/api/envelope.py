@@ -6,14 +6,11 @@ nakama-common rtapi/realtime.proto:37-135). MESSAGE_KEYS enumerates the
 client→server and server→client variants; the pipeline validates membership
 before dispatch.
 
-Wire-format decision: the reference negotiates protobuf|json per socket
-(reference socket_ws.go:58-80) because its clients ship generated proto
-stubs. This framework defines its own client contract, and JSON is that
-contract — one canonical encoding end to end (REST and realtime share it),
-no generated-code toolchain, and the hot data path (matchmaker intervals)
-lives on-device where the socket encoding is irrelevant. The `format`
-query parameter survives at the acceptor (api/socket.py) as the seam if a
-binary encoding is ever warranted.
+Wire-format decision (updated round 3): the dict envelope is the canonical
+in-process representation; the socket negotiates `format=json|protobuf`
+like the reference (socket_ws.go:58-80) and api/protocol.py bridges the
+binary encoding through proto/rtapi.proto — the pipeline and every
+handler stay encoding-agnostic.
 """
 
 from __future__ import annotations

@@ -1,0 +1,364 @@
+"""gRPC front door — transcodes every NakamaApi rpc onto the REST stack.
+
+Architecture (the inverse of the reference): the reference is gRPC-first
+and derives REST through grpc-gateway (reference server/api.go:148-208);
+this framework is REST-first and derives gRPC through this gateway. Each
+rpc is one `RouteSpec` row mapping the typed proto request onto the
+corresponding REST route over an in-process loopback connection — the
+auth interceptors, runtime before/after hooks, and error mapping all run
+exactly once, in the REST layer, for both protocols.
+
+The bridge is protobuf json_format both ways (request message -> JSON
+body/query, JSON response -> response message), so the proto contract in
+proto/api.proto and the JSON contract can never drift apart silently: a
+shape mismatch fails the transcode and the tests.
+
+Auth passes through the grpc `authorization` metadata key verbatim
+(Basic server-key for authenticate rpcs, Bearer session elsewhere —
+reference apigrpc SecurityInterceptor, server/api.go:101).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Callable
+
+import grpc
+from google.protobuf import json_format
+
+from ..logger import Logger
+from ..proto import api_pb2
+
+_SERVICE = "nakama_tpu.api.NakamaApi"
+
+# grpc code int (REST error body "code") -> grpc.StatusCode
+_STATUS = {c.value[0]: c for c in grpc.StatusCode}
+
+
+@dataclass
+class RouteSpec:
+    verb: str
+    path: str | Callable[[dict], str]
+    request: type
+    response: type
+    # body("json"): MessageToDict becomes the JSON body;
+    # body("query"): fields become query-string params; body(None): bare.
+    body: str | None = "json"
+    # Fields consumed by the path template, removed from the body.
+    path_fields: tuple = ()
+    # Rewrites applied to the dict before dispatch.
+    transform: Callable[[dict], dict] | None = None
+
+
+def _flatten_account(body: dict) -> dict:
+    """Link/unlink REST bodies are the provider account fields directly."""
+    return body.get("account") or {}
+
+
+P = api_pb2
+
+ROUTES: dict[str, RouteSpec] = {
+    "Healthcheck": RouteSpec("GET", "/v2/healthcheck", P.Empty, P.Empty,
+                             body=None),
+    "SessionRefresh": RouteSpec(
+        "POST", "/v2/account/session/refresh",
+        P.SessionRefreshRequest, P.Session,
+    ),
+    "SessionLogout": RouteSpec(
+        "POST", "/v2/session/logout",
+        P.SessionLogoutRequest, P.Empty,
+    ),
+    "GetAccount": RouteSpec("GET", "/v2/account", P.Empty, P.Account,
+                            body=None),
+    "UpdateAccount": RouteSpec(
+        "PUT", "/v2/account", P.UpdateAccountRequest, P.Empty,
+    ),
+    "DeleteAccount": RouteSpec("DELETE", "/v2/account", P.Empty, P.Empty,
+                               body=None),
+    "GetUsers": RouteSpec(
+        "GET", "/v2/user", P.GetUsersRequest, P.Users,
+        body="query",
+    ),
+    "ReadStorageObjects": RouteSpec(
+        "POST", "/v2/storage",
+        P.ReadStorageObjectsRequest, P.StorageObjects,
+    ),
+    "WriteStorageObjects": RouteSpec(
+        "PUT", "/v2/storage",
+        P.WriteStorageObjectsRequest, P.StorageObjectAcks,
+    ),
+    "DeleteStorageObjects": RouteSpec(
+        "PUT", "/v2/storage/delete",
+        P.DeleteStorageObjectsRequest, P.Empty,
+    ),
+    "ListStorageObjects": RouteSpec(
+        "GET",
+        lambda d: (
+            f"/v2/storage/{d.get('collection', '')}"
+            + (f"/{d['user_id']}" if d.get("user_id") else "")
+        ),
+        P.ListStorageObjectsRequest, P.StorageObjectList,
+        body="query",
+        path_fields=("collection", "user_id"),
+    ),
+    "Event": RouteSpec("POST", "/v2/event", P.EventRequest, P.Empty),
+    "ListMatches": RouteSpec(
+        "GET", "/v2/match", P.ListMatchesRequest, P.MatchList,
+        body="query",
+    ),
+    "ListFriends": RouteSpec(
+        "GET", "/v2/friend", P.ListFriendsRequest, P.FriendList,
+        body="query",
+    ),
+    "AddFriends": RouteSpec(
+        "POST", "/v2/friend", P.AddFriendsRequest, P.Empty, body="query",
+    ),
+    "DeleteFriends": RouteSpec(
+        "DELETE", "/v2/friend", P.AddFriendsRequest, P.Empty, body="query",
+    ),
+    "BlockFriends": RouteSpec(
+        "POST", "/v2/friend/block", P.AddFriendsRequest, P.Empty,
+        body="query",
+    ),
+    "ListGroups": RouteSpec(
+        "GET", "/v2/group", P.ListGroupsRequest, P.GroupList, body="query",
+    ),
+    "CreateGroup": RouteSpec(
+        "POST", "/v2/group", P.CreateGroupRequest, P.Group,
+    ),
+    "DeleteGroup": RouteSpec(
+        "DELETE", lambda d: f"/v2/group/{d.get('group_id', '')}",
+        P.GroupIdRequest, P.Empty, body=None,
+        path_fields=("group_id",),
+    ),
+    "ListGroupUsers": RouteSpec(
+        "GET", lambda d: f"/v2/group/{d.get('group_id', '')}/user",
+        P.ListGroupUsersRequest, P.GroupUserList,
+        body="query", path_fields=("group_id",),
+    ),
+    "ListUserGroups": RouteSpec(
+        "GET", lambda d: f"/v2/user/{d.get('user_id', '')}/group",
+        P.ListUserGroupsRequest, P.UserGroupList,
+        body="query", path_fields=("user_id",),
+    ),
+    "ListLeaderboardRecords": RouteSpec(
+        "GET", lambda d: f"/v2/leaderboard/{d.get('leaderboard_id', '')}",
+        P.ListLeaderboardRecordsRequest, P.LeaderboardRecordList,
+        body="query", path_fields=("leaderboard_id",),
+    ),
+    "WriteLeaderboardRecord": RouteSpec(
+        "POST", lambda d: f"/v2/leaderboard/{d.get('leaderboard_id', '')}",
+        P.WriteLeaderboardRecordRequest, P.LeaderboardRecord,
+        path_fields=("leaderboard_id",),
+    ),
+    "DeleteLeaderboardRecord": RouteSpec(
+        "DELETE", lambda d: f"/v2/leaderboard/{d.get('leaderboard_id', '')}",
+        P.DeleteLeaderboardRecordRequest, P.Empty, body=None,
+        path_fields=("leaderboard_id",),
+    ),
+    "ListNotifications": RouteSpec(
+        "GET", "/v2/notification",
+        P.ListNotificationsRequest, P.NotificationList, body="query",
+    ),
+    "DeleteNotifications": RouteSpec(
+        "DELETE", "/v2/notification",
+        P.DeleteNotificationsRequest, P.Empty, body="query",
+    ),
+    "ListSubscriptions": RouteSpec(
+        "GET", "/v2/iap/subscription", P.Empty, P.SubscriptionList,
+        body=None,
+    ),
+}
+
+for _provider in (
+    "device", "email", "custom", "apple", "facebook", "google", "steam",
+):
+    ROUTES[f"Authenticate{_provider.capitalize()}"] = RouteSpec(
+        "POST", f"/v2/account/authenticate/{_provider}",
+        P.AuthenticateRequest, P.Session,
+    )
+for _provider in ("device", "email", "custom"):
+    cap = _provider.capitalize()
+    ROUTES[f"Link{cap}"] = RouteSpec(
+        "POST", f"/v2/account/link/{_provider}", P.LinkRequest, P.Empty,
+        transform=_flatten_account,
+    )
+    ROUTES[f"Unlink{cap}"] = RouteSpec(
+        "POST", f"/v2/account/unlink/{_provider}", P.LinkRequest, P.Empty,
+        transform=_flatten_account,
+    )
+for _action, _msg in (
+    ("join", P.GroupIdRequest), ("leave", P.GroupIdRequest),
+    ("add", P.GroupUsersRequest), ("kick", P.GroupUsersRequest),
+    ("ban", P.GroupUsersRequest), ("promote", P.GroupUsersRequest),
+    ("demote", P.GroupUsersRequest),
+):
+    name = {
+        "join": "JoinGroup", "leave": "LeaveGroup",
+        "add": "AddGroupUsers", "kick": "KickGroupUsers",
+        "ban": "BanGroupUsers", "promote": "PromoteGroupUsers",
+        "demote": "DemoteGroupUsers",
+    }[_action]
+    ROUTES[name] = RouteSpec(
+        "POST",
+        (lambda action: lambda d: (
+            f"/v2/group/{d.get('group_id', '')}/{action}"
+        ))(_action),
+        _msg, P.Empty, body="query", path_fields=("group_id",),
+    )
+for _store in ("apple", "google", "huawei"):
+    ROUTES[f"ValidatePurchase{_store.capitalize()}"] = RouteSpec(
+        "POST", f"/v2/iap/purchase/{_store}",
+        P.ValidatePurchaseRequest, P.PurchaseList,
+    )
+for _store in ("apple", "google"):
+    ROUTES[f"ValidateSubscription{_store.capitalize()}"] = RouteSpec(
+        "POST", f"/v2/iap/subscription/{_store}",
+        P.ValidateSubscriptionRequest, P.ValidateSubscriptionResponse,
+    )
+ROUTES["GetSubscription"] = RouteSpec(
+    "GET",
+    lambda d: (
+        f"/v2/iap/subscription/{d.get('original_transaction_id', '')}"
+    ),
+    P.GetSubscriptionRequest, P.ValidatedSubscription, body=None,
+    path_fields=("original_transaction_id",),
+)
+ROUTES["ImportFacebookFriends"] = RouteSpec(
+    "POST", "/v2/friend/facebook",
+    P.ImportFacebookFriendsRequest, P.ImportFriendsResponse,
+)
+ROUTES["ImportSteamFriends"] = RouteSpec(
+    "POST", "/v2/friend/steam",
+    P.ImportSteamFriendsRequest, P.ImportFriendsResponse,
+)
+ROUTES["RpcFunc"] = RouteSpec(
+    "POST", lambda d: f"/v2/rpc/{d.get('id', '')}",
+    P.Rpc, P.Rpc, body="rpc", path_fields=("id",),
+)
+
+
+class GrpcGateway:
+    """grpc.aio server hosting NakamaApi by loopback onto the REST port."""
+
+    def __init__(self, logger: Logger, rest_host: str, rest_port: int):
+        self.logger = logger.with_fields(subsystem="grpc")
+        self._base = f"http://{rest_host}:{rest_port}"
+        self._server: grpc.aio.Server | None = None
+        self.port: int | None = None
+        self._http = None  # aiohttp.ClientSession, created at start
+
+    # ------------------------------------------------------------ handlers
+
+    def _make_handler(self, name: str, spec: RouteSpec):
+        async def handler(request, context):
+            meta = dict(context.invocation_metadata() or ())
+            auth = meta.get("authorization", "")
+            try:
+                return await self._call(spec, request, auth)
+            except _ApiStatusError as e:
+                await context.abort(e.code, e.message)
+            except Exception as e:  # transcode/transport failure
+                self.logger.error(
+                    "grpc transcode error", rpc=name, error=str(e)
+                )
+                await context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+        return grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=spec.request.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+
+    async def _call(self, spec: RouteSpec, request, auth: str):
+        body = json_format.MessageToDict(
+            request, preserving_proto_field_name=True
+        )
+        if spec.transform is not None:
+            body = spec.transform(body)
+        path = spec.path(body) if callable(spec.path) else spec.path
+        for f in spec.path_fields:
+            body.pop(f, None)
+
+        params: list[tuple[str, str]] = []
+        json_body = None
+        data = None
+        if spec.body == "query":
+            for k, v in body.items():
+                if isinstance(v, list):
+                    params.extend((k, str(x)) for x in v)
+                elif isinstance(v, bool):
+                    params.append((k, "true" if v else "false"))
+                else:
+                    params.append((k, str(v)))
+        elif spec.body == "rpc":
+            data = json.dumps(body.get("payload", ""))
+            if body.get("http_key"):
+                params.append(("http_key", body["http_key"]))
+        elif spec.body == "json":
+            json_body = body
+
+        headers = {}
+        if auth:
+            headers["Authorization"] = auth
+        async with self._http.request(
+            spec.verb,
+            self._base + path,
+            params=params or None,
+            json=json_body,
+            data=data,
+            headers=headers,
+        ) as resp:
+            payload = await resp.json(content_type=None)
+            if resp.status >= 400:
+                code = _STATUS.get(
+                    (payload or {}).get("code", 13), grpc.StatusCode.INTERNAL
+                )
+                raise _ApiStatusError(
+                    code, (payload or {}).get("message", "")
+                )
+        return json_format.ParseDict(
+            payload or {}, spec.response(), ignore_unknown_fields=True
+        )
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self, host: str, port: int) -> int:
+        import aiohttp
+
+        self._http = aiohttp.ClientSession()
+        self._server = grpc.aio.server()
+        handlers = {
+            name: self._make_handler(name, spec)
+            for name, spec in ROUTES.items()
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if not self.port:
+            # add_insecure_port signals bind failure by returning 0, not
+            # raising — a silent 0 here would leave gRPC dead with a
+            # healthy-looking log line.
+            raise OSError(f"grpc gateway failed to bind {host}:{port}")
+        await self._server.start()
+        self.logger.info("grpc gateway listening", port=self.port)
+        return self.port
+
+    async def stop(self):
+        if self._http is not None:
+            await self._http.close()
+            self._http = None
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+
+
+class _ApiStatusError(Exception):
+    """REST error carried to the handler, aborted with the mapped status."""
+
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message

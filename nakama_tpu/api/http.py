@@ -71,7 +71,9 @@ def _error_response(message: str, status: int, grpc_code: int):
 class _WsAdapter:
     """Presents aiohttp's WebSocketResponse with the `websockets`-library
     surface the SocketAcceptor/WebSocketSession expect: `request.path`,
-    `send(str)`, `close(code, reason)`, and text-frame iteration."""
+    `send(str | bytes)`, `close(code, reason)`, and frame iteration.
+    Binary frames carry the protobuf envelope encoding; text frames
+    JSON."""
 
     class _Req:
         def __init__(self, path: str):
@@ -81,8 +83,11 @@ class _WsAdapter:
         self._ws = ws
         self.request = self._Req(path_qs)
 
-    async def send(self, data: str):
-        await self._ws.send_str(data)
+    async def send(self, data):
+        if isinstance(data, (bytes, bytearray)):
+            await self._ws.send_bytes(data)
+        else:
+            await self._ws.send_str(data)
 
     async def close(self, code: int = 1000, reason: str = ""):
         await self._ws.close(code=code, message=reason.encode())
@@ -92,7 +97,7 @@ class _WsAdapter:
 
     async def _iter(self):
         async for msg in self._ws:
-            if msg.type == WSMsgType.TEXT:
+            if msg.type in (WSMsgType.TEXT, WSMsgType.BINARY):
                 yield msg.data
             elif msg.type in (WSMsgType.ERROR, WSMsgType.CLOSE):
                 return
@@ -172,6 +177,8 @@ class ApiServer:
         r.add_post("/v2/friend", self._h_friend_add)
         r.add_delete("/v2/friend", self._h_friend_delete)
         r.add_post("/v2/friend/block", self._h_friend_block)
+        r.add_post("/v2/friend/facebook", self._h_friend_import_facebook)
+        r.add_post("/v2/friend/steam", self._h_friend_import_steam)
 
         r.add_get("/v2/group", self._h_group_list)
         r.add_post("/v2/group", self._h_group_create)
@@ -195,6 +202,15 @@ class ApiServer:
                 self._make_iap_validate(store),
             )
         r.add_get("/v2/iap/subscription", self._h_subscription_list)
+        for store in ("apple", "google"):
+            r.add_post(
+                f"/v2/iap/subscription/{store}",
+                self._make_subscription_validate(store),
+            )
+        r.add_get(
+            "/v2/iap/subscription/{original_transaction_id}",
+            self._h_subscription_get,
+        )
 
     # ----------------------------------------------------------- lifecycle
 
@@ -941,6 +957,55 @@ class ApiServer:
 
         return handler
 
+    def _make_subscription_validate(self, store: str):
+        """ValidateSubscriptionApple/Google (reference apigrpc.proto:678,
+        :694; iap.go:625-646)."""
+
+        async def handler(request: web.Request):
+            from ..iap import IAPError
+
+            try:
+                claims = self._session(request)
+                body = await self._json(request)
+                receipt = body.get("receipt", "")
+                if not receipt:
+                    raise ApiError(
+                        "receipt required", 400, GRPC_INVALID_ARGUMENT
+                    )
+                fn = getattr(
+                    self.server.purchases,
+                    f"validate_subscription_{store}",
+                )
+                try:
+                    sub = await fn(
+                        claims.user_id,
+                        receipt,
+                        persist=_parse_bool(body.get("persist", True)),
+                    )
+                except IAPError as e:
+                    raise ApiError(str(e), 400, GRPC_INVALID_ARGUMENT)
+                return web.json_response({"validated_subscription": sub})
+            except Exception as e:
+                return self._map_error(e)
+
+        return handler
+
+    async def _h_subscription_get(self, request: web.Request):
+        """GetSubscription (reference apigrpc.proto:344): by original
+        transaction id, owner-gated."""
+        try:
+            claims = self._session(request)
+            sub = await self.server.purchases.get_subscription(
+                request.match_info["original_transaction_id"]
+            )
+            if sub is None or sub.get("user_id") != claims.user_id:
+                raise ApiError(
+                    "subscription not found", 404, GRPC_NOT_FOUND
+                )
+            return web.json_response(sub)
+        except Exception as e:
+            return self._map_error(e)
+
     async def _h_subscription_list(self, request: web.Request):
         try:
             claims = self._session(request)
@@ -991,6 +1056,90 @@ class ApiServer:
             )
             ids.extend(u["id"] for u in users)
         return ids
+
+    async def _h_friend_import_facebook(self, request: web.Request):
+        """ImportFacebookFriends (reference apigrpc.proto:354): verify the
+        Graph token, fetch its app-friend list, import as direct mutual
+        friends."""
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            body = await self._hooked(
+                "importfacebookfriends", claims, body
+            )
+            if body is None:
+                raise ApiError(
+                    "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                )
+            social = self.server.social
+            if social is None:
+                raise ApiError(
+                    "facebook not configured", 501, GRPC_UNIMPLEMENTED
+                )
+            account = body.get("account", body)
+            token = account.get("token", "")
+            await social.verify_facebook(token)  # token must be live
+            friend_ids = await social.fetch_facebook_friends(token)
+            imported = await self.server.friends.import_by_provider_ids(
+                claims.user_id,
+                claims.username,
+                "facebook_id",
+                friend_ids,
+                reset=_parse_bool(
+                    request.query.get("reset", body.get("reset", False))
+                ),
+            )
+            result = {"imported": imported}
+            await self._after(
+                "importfacebookfriends", claims, body, result
+            )
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_friend_import_steam(self, request: web.Request):
+        """ImportSteamFriends (reference apigrpc.proto:362): resolve the
+        caller's linked steam id, fetch the Steam friend list with the
+        publisher key, import as direct mutual friends."""
+        try:
+            claims = self._session(request)
+            body = await self._json(request)
+            body = await self._hooked("importsteamfriends", claims, body)
+            if body is None:
+                raise ApiError(
+                    "rejected by before hook", 403, GRPC_PERMISSION_DENIED
+                )
+            social = self.server.social
+            if social is None:
+                raise ApiError(
+                    "steam not configured", 501, GRPC_UNIMPLEMENTED
+                )
+            row = await self.server.db.fetch_one(
+                "SELECT steam_id FROM users WHERE id = ?",
+                (claims.user_id,),
+            )
+            steam_id = (row or {}).get("steam_id") or ""
+            if not steam_id:
+                raise ApiError(
+                    "no steam account linked", 400, GRPC_INVALID_ARGUMENT
+                )
+            friend_ids = await social.fetch_steam_friends(
+                self.config.social.steam_publisher_key, steam_id
+            )
+            imported = await self.server.friends.import_by_provider_ids(
+                claims.user_id,
+                claims.username,
+                "steam_id",
+                friend_ids,
+                reset=_parse_bool(
+                    request.query.get("reset", body.get("reset", False))
+                ),
+            )
+            result = {"imported": imported}
+            await self._after("importsteamfriends", claims, body, result)
+            return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
 
     async def _h_friend_list(self, request: web.Request):
         try:

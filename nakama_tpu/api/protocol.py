@@ -1,0 +1,71 @@
+"""Wire-format codecs for the realtime envelope.
+
+The JSON dict envelope (api/envelope.py) is the canonical in-process
+representation; this module maps it to the negotiated socket encoding.
+`format=json` is a passthrough; `format=protobuf` bridges through the
+rtapi proto (nakama_tpu/proto/rtapi.proto) via protobuf json_format, so
+the pipeline, router, and every handler stay encoding-agnostic — exactly
+one encode and one decode site exist per socket (session_ws.py).
+
+Reference seam: the reference negotiates protobuf|json per socket and
+branches in its read/write loops (server/socket_ws.go:46-80,
+session_ws.go:420-441). Here the branch is a codec object chosen once at
+accept time.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+FORMAT_JSON = "json"
+FORMAT_PROTOBUF = "protobuf"
+SUPPORTED_FORMATS = (FORMAT_JSON, FORMAT_PROTOBUF)
+
+Wire = Union[str, bytes]
+
+
+class ProtocolError(ValueError):
+    """Malformed inbound frame for the negotiated encoding."""
+
+
+def encode(envelope: dict, fmt: str) -> Wire:
+    if fmt == FORMAT_JSON:
+        import json
+
+        return json.dumps(envelope)
+    from google.protobuf import json_format
+
+    from ..proto import rtapi_pb2
+
+    # ignore_unknown_fields: an outgoing dict carrying a field the proto
+    # schema hasn't caught up with must degrade (field dropped for binary
+    # clients) rather than kill the socket.
+    msg = json_format.ParseDict(
+        envelope, rtapi_pb2.Envelope(), ignore_unknown_fields=True
+    )
+    return msg.SerializeToString()
+
+
+def decode(raw: Wire, fmt: str) -> dict:
+    if fmt == FORMAT_JSON:
+        import json
+
+        try:
+            envelope = json.loads(raw)
+        except ValueError as e:
+            raise ProtocolError(str(e)) from e
+        if not isinstance(envelope, dict):
+            raise ProtocolError("not an object")
+        return envelope
+    from google.protobuf import json_format
+    from google.protobuf.message import DecodeError
+
+    from ..proto import rtapi_pb2
+
+    if isinstance(raw, str):
+        raw = raw.encode()
+    try:
+        msg = rtapi_pb2.Envelope.FromString(raw)
+    except DecodeError as e:
+        raise ProtocolError(str(e)) from e
+    return json_format.MessageToDict(msg, preserving_proto_field_name=True)

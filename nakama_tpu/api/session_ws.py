@@ -12,11 +12,11 @@ callback.
 from __future__ import annotations
 
 import asyncio
-import json
 import uuid
 from typing import Any, Callable
 
 from ..logger import Logger
+from . import protocol
 
 
 class WebSocketSession:
@@ -89,7 +89,9 @@ class WebSocketSession:
                 envelope = await self._outgoing.get()
                 if envelope is None:
                     return
-                await self.ws.send(json.dumps(envelope))
+                await self.ws.send(
+                    protocol.encode(envelope, self._format)
+                )
         except Exception:
             await self.close("write error")
 
@@ -104,10 +106,8 @@ class WebSocketSession:
         try:
             async for raw in self.ws:
                 try:
-                    envelope = json.loads(raw)
-                    if not isinstance(envelope, dict):
-                        raise ValueError("not an object")
-                except ValueError:
+                    envelope = protocol.decode(raw, self._format)
+                except protocol.ProtocolError:
                     self.logger.debug("malformed envelope, closing")
                     break
                 result = process(self, envelope)
@@ -125,16 +125,22 @@ class WebSocketSession:
             return
         self._closed = True
         if self._writer_task is not None:
-            # Let queued messages flush briefly, then stop the writer.
-            try:
-                self._outgoing.put_nowait(None)
-            except asyncio.QueueFull:
-                self._writer_task.cancel()
-            try:
-                await asyncio.wait_for(self._writer_task, timeout=1.0)
-            except (asyncio.TimeoutError, asyncio.CancelledError):
-                self._writer_task.cancel()
-            self._writer_task = None
+            if asyncio.current_task() is self._writer_task:
+                # close() reached from the writer's own error path: the
+                # task cannot await itself — it is already unwinding, so
+                # just drop the handle.
+                self._writer_task = None
+            else:
+                # Let queued messages flush briefly, then stop the writer.
+                try:
+                    self._outgoing.put_nowait(None)
+                except asyncio.QueueFull:
+                    self._writer_task.cancel()
+                try:
+                    await asyncio.wait_for(self._writer_task, timeout=1.0)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    self._writer_task.cancel()
+                self._writer_task = None
         try:
             await self.ws.close()
         except Exception:

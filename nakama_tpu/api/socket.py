@@ -24,7 +24,7 @@ from ..realtime import (
     Stream,
     StreamMode,
 )
-from . import session_token
+from . import protocol, session_token
 from .session_ws import WebSocketSession
 
 
@@ -66,7 +66,7 @@ class SocketAcceptor:
             "true",
             "1",
         )
-        if fmt not in ("json",):
+        if fmt not in protocol.SUPPORTED_FORMATS:
             await ws.close(4000, "unsupported format")
             return
         try:

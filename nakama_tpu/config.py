@@ -61,6 +61,10 @@ class SocketConfig:
     pong_wait_ms: int = 25_000
     ping_backoff_threshold: int = 20
     outgoing_queue_size: int = 64
+    # gRPC front door port (reference convention: gRPC on port-1 = 7349,
+    # HTTP on 7350, console on 7351 — server/config.go). 0 = main port - 1
+    # (ephemeral when port is 0); -1 disables the gRPC listener.
+    grpc_port: int = 0
 
 
 @dataclass

@@ -119,6 +119,72 @@ class Friends:
             except Exception as e:
                 self.logger.error("friend notification", error=str(e))
 
+    async def import_by_provider_ids(
+        self,
+        user_id: str,
+        username: str,
+        provider_column: str,
+        provider_ids: list[str],
+        reset: bool = False,
+    ) -> int:
+        """Social-graph bootstrap (reference importFriendsByUID,
+        core_friend.go: ImportFacebookFriends / ImportSteamFriends):
+        provider friend ids resolve to users with that id linked, and
+        each becomes a DIRECT mutual friend (no invite round-trip — both
+        sides proved the relationship to the provider). `reset` first
+        deletes existing non-blocked friend edges, matching the
+        reference's reset semantics. Returns the number imported."""
+        assert provider_column in ("facebook_id", "steam_id")
+        now = time.time()
+        imported = 0
+        async with self.db.tx() as tx:
+            if reset:
+                rows = await tx.fetch_all(
+                    "SELECT destination_id, state FROM user_edge"
+                    " WHERE source_id = ?",
+                    (user_id,),
+                )
+                for r in rows:
+                    if r["state"] == BLOCKED:
+                        continue
+                    await self._del_edge(tx, user_id, r["destination_id"])
+                    theirs = await self._edge(
+                        tx, r["destination_id"], user_id
+                    )
+                    if theirs is not None and theirs["state"] != BLOCKED:
+                        await self._del_edge(
+                            tx, r["destination_id"], user_id
+                        )
+            if not provider_ids:
+                return 0
+            placeholders = ",".join("?" for _ in provider_ids)
+            rows = await tx.fetch_all(
+                f"SELECT id FROM users WHERE {provider_column}"
+                f" IN ({placeholders})",
+                tuple(str(p) for p in provider_ids),
+            )
+            for r in rows:
+                fid = r["id"]
+                if fid == user_id:
+                    continue
+                mine = await self._edge(tx, user_id, fid)
+                theirs = await self._edge(tx, fid, user_id)
+                if (mine is not None and mine["state"] == BLOCKED) or (
+                    theirs is not None and theirs["state"] == BLOCKED
+                ):
+                    continue
+                if mine is not None and mine["state"] == FRIEND:
+                    continue
+                await self._set_edge(tx, user_id, fid, FRIEND, now)
+                await self._set_edge(tx, fid, user_id, FRIEND, now)
+                imported += 1
+        self.logger.info(
+            "friends imported",
+            provider=provider_column,
+            count=imported,
+        )
+        return imported
+
     async def delete(self, user_id: str, friend_id: str):
         """Remove friendship/invite both ways; a block I placed stays
         (reference DeleteFriends)."""

@@ -181,6 +181,67 @@ class Purchases:
 
     # -------------------------------------------------------- subscriptions
 
+    async def validate_subscription_apple(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> dict:
+        """Client-facing subscription validation (reference
+        apigrpc.proto:678 ValidateSubscriptionApple; iap.go:625)."""
+        from ..iap import validate_subscription_apple
+
+        v = await validate_subscription_apple(
+            self.config.iap.apple_shared_password, receipt, self._fetch
+        )
+        return await self._store_subscription(user_id, v, persist)
+
+    async def validate_subscription_google(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> dict:
+        """Reference apigrpc.proto:694 ValidateSubscriptionGoogle."""
+        from ..iap import validate_subscription_google
+
+        v = await validate_subscription_google(
+            self.config.iap.google_client_email,
+            self.config.iap.google_private_key,
+            receipt,
+            self._fetch,
+        )
+        return await self._store_subscription(user_id, v, persist)
+
+    async def _store_subscription(self, user_id, v, persist: bool) -> dict:
+        if persist:
+            # Re-validating another user's receipt must fail loudly, not
+            # half-update their row and return an inconsistent success
+            # (the purchase path reports the stored owner; subscriptions
+            # are owner-exclusive in the reference).
+            existing = await self.get_subscription(
+                v.original_transaction_id
+            )
+            if existing is not None and existing["user_id"] != user_id:
+                from ..iap import IAPError
+
+                raise IAPError(
+                    "subscription belongs to another user", "invalid"
+                )
+            return await self.upsert_subscription(
+                user_id,
+                v.original_transaction_id,
+                v.product_id,
+                v.store,
+                v.expire_time,
+                environment=v.environment,
+                raw_response=v.raw_response,
+            )
+        return {
+            "user_id": user_id,
+            "original_transaction_id": v.original_transaction_id,
+            "product_id": v.product_id,
+            "store": v.store,
+            "purchase_time": v.purchase_time,
+            "expire_time": v.expire_time,
+            "active": v.expire_time > time.time(),
+            "environment": v.environment,
+        }
+
     async def upsert_subscription(
         self,
         user_id: str,

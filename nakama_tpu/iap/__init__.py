@@ -11,6 +11,8 @@ from .client import (
     google_access_token,
     validate_receipt_apple,
     validate_receipt_google,
+    validate_subscription_apple,
+    validate_subscription_google,
     validate_receipt_huawei,
 )
 from .refund import GoogleRefundScheduler
@@ -27,5 +29,7 @@ __all__ = [
     "ValidatedPurchase",
     "validate_receipt_apple",
     "validate_receipt_google",
+    "validate_subscription_apple",
+    "validate_subscription_google",
     "validate_receipt_huawei",
 ]

@@ -63,11 +63,13 @@ def _default_fetch(url, method="GET", headers=None, body=None):
 # ---------------------------------------------------------------- apple
 
 
-async def validate_receipt_apple(
-    shared_password: str, receipt: str, fetch=None
-) -> list[ValidatedPurchase]:
+async def _apple_verify_receipt(
+    shared_password: str, receipt: str, fetch
+) -> tuple[dict, int]:
     """POST the base64 receipt to verifyReceipt; status 21007 retries
-    against the sandbox endpoint (reference iap.go:150-166)."""
+    against the sandbox endpoint (reference iap.go:150-166). The one
+    Apple call path shared by purchase and subscription validation —
+    returns (response, environment)."""
     if not shared_password:
         raise IAPError("apple shared password not configured")
     fetch = fetch or _default_fetch
@@ -96,6 +98,15 @@ async def validate_receipt_apple(
         environment = ENV_SANDBOX
     if data.get("status") != 0:
         raise IAPError(f"apple receipt invalid: status {data.get('status')}")
+    return data, environment
+
+
+async def validate_receipt_apple(
+    shared_password: str, receipt: str, fetch=None
+) -> list[ValidatedPurchase]:
+    data, environment = await _apple_verify_receipt(
+        shared_password, receipt, fetch
+    )
     in_app = (data.get("receipt") or {}).get("in_app") or []
     if not in_app:
         raise IAPError("apple receipt contains no purchases")
@@ -112,6 +123,104 @@ async def validate_receipt_apple(
             )
         )
     return out
+
+
+@dataclass
+class ValidatedSubscription:
+    store: int
+    original_transaction_id: str
+    product_id: str
+    purchase_time: float
+    expire_time: float
+    environment: int = ENV_UNKNOWN
+    raw_response: dict | None = None
+
+
+async def validate_subscription_apple(
+    shared_password: str, receipt: str, fetch=None
+) -> ValidatedSubscription:
+    """Auto-renewable subscription via verifyReceipt's
+    latest_receipt_info (reference iap.go:625 ValidateSubscription
+    ReceiptApple): newest expiry wins across renewal rows."""
+    data, environment = await _apple_verify_receipt(
+        shared_password, receipt, fetch
+    )
+    latest = data.get("latest_receipt_info") or []
+    if not latest:
+        raise IAPError("apple receipt contains no subscription")
+    newest = max(
+        latest, key=lambda i: float(i.get("expires_date_ms", 0))
+    )
+    return ValidatedSubscription(
+        store=STORE_APPLE,
+        original_transaction_id=newest.get(
+            "original_transaction_id", ""
+        ),
+        product_id=newest.get("product_id", ""),
+        purchase_time=float(newest.get("purchase_date_ms", 0)) / 1000,
+        expire_time=float(newest.get("expires_date_ms", 0)) / 1000,
+        environment=environment,
+        raw_response=data,
+    )
+
+
+async def validate_subscription_google(
+    client_email: str,
+    private_key_pem: str,
+    receipt: str,
+    fetch=None,
+) -> ValidatedSubscription:
+    """Play subscription via androidpublisher subscriptions.get
+    (reference iap.go:646 ValidateSubscriptionReceiptGoogle)."""
+    if not client_email or not private_key_pem:
+        raise IAPError("google IAP credentials not configured")
+    fetch = fetch or _default_fetch
+    try:
+        purchase = json.loads(receipt)
+    except ValueError:
+        raise IAPError("google receipt must be the purchase JSON")
+    package = purchase.get("packageName", "")
+    product_id = purchase.get("productId", "")
+    token = purchase.get("purchaseToken", "")
+    if not (package and product_id and token):
+        raise IAPError("google receipt missing fields")
+
+    access_token = await google_access_token(
+        client_email, private_key_pem, fetch
+    )
+    import urllib.parse as _up
+
+    url = (
+        f"{GOOGLE_PUBLISHER_URL}/androidpublisher/v3/applications/"
+        f"{_up.quote(package, safe='')}/purchases/subscriptions/"
+        f"{_up.quote(product_id, safe='')}/tokens/"
+        f"{_up.quote(token, safe='')}"
+    )
+    status, body = await fetch(
+        url, headers={"Authorization": f"Bearer {access_token}"}
+    )
+    if status != 200:
+        raise IAPError(f"google subscription lookup failed: HTTP {status}")
+    data = json.loads(body)
+    expiry_ms = float(data.get("expiryTimeMillis", 0))
+    if not expiry_ms:
+        raise IAPError("google subscription has no expiry")
+    return ValidatedSubscription(
+        store=STORE_GOOGLE,
+        # The purchaseToken is the STABLE subscription identity; orderId
+        # grows a new ..N suffix every renewal, which would fork a fresh
+        # row per renewal cycle (the reference keys on the token too).
+        original_transaction_id=token,
+        product_id=product_id,
+        purchase_time=float(data.get("startTimeMillis", 0)) / 1000,
+        expire_time=expiry_ms / 1000,
+        environment=(
+            ENV_SANDBOX
+            if data.get("purchaseType") == 0
+            else ENV_PRODUCTION
+        ),
+        raw_response=data,
+    )
 
 
 # --------------------------------------------------------------- google

@@ -1,0 +1,31 @@
+"""Generated protobuf modules + regeneration helper.
+
+`rtapi_pb2.py` / `api_pb2.py` are committed generated code (protoc
+3.21-series gencode, validated against the installed protobuf runtime by
+tests/test_transport.py). Regenerate after editing the .proto sources:
+
+    python -m nakama_tpu.proto
+"""
+
+from . import rtapi_pb2  # noqa: F401
+
+try:  # api_pb2 lands with the gRPC front door
+    from . import api_pb2  # noqa: F401
+except ImportError:  # pragma: no cover
+    api_pb2 = None
+
+
+def regenerate():  # pragma: no cover - developer tool
+    import pathlib
+    import subprocess
+
+    here = pathlib.Path(__file__).parent
+    protos = sorted(p.name for p in here.glob("*.proto"))
+    subprocess.run(
+        ["protoc", f"-I{here}", f"--python_out={here}"] + protos,
+        check=True,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    regenerate()

@@ -186,6 +186,8 @@ class NakamaServer:
 
         self.api = ApiServer(self)
         self.console = ConsoleServer(self)
+        self.grpc = None
+        self.grpc_port: int | None = None
 
     def attach_runtime(self, runtime):
         """Wire the extensibility runtime into the pipeline, the matchmaker
@@ -272,8 +274,29 @@ class NakamaServer:
             self.config.console.address or "127.0.0.1",
             0 if self.config.socket.port == 0 else self.config.console.port,
         )
+        # gRPC front door: the NakamaApi service transcoding onto the REST
+        # listener (api/grpc_server.py; reference convention puts gRPC on
+        # port-1 = 7349 next to HTTP 7350 — port 0 in tests).
+        if self.config.socket.grpc_port >= 0:
+            from .api.grpc_server import GrpcGateway
+
+            # Loopback must target the address the REST listener actually
+            # bound, not a hardcoded localhost.
+            self.grpc = GrpcGateway(
+                self.logger,
+                self.config.socket.address or "127.0.0.1",
+                self.port,
+            )
+            self.grpc_port = await self.grpc.start(
+                self.config.socket.address or "127.0.0.1",
+                0 if self.config.socket.port == 0
+                else self.config.socket.grpc_port or self.port - 1,
+            )
         self.logger.info(
-            "server listening", port=self.port, console=self.console_port
+            "server listening",
+            port=self.port,
+            console=self.console_port,
+            grpc=self.grpc_port,
         )
 
     async def stop(self, grace_seconds: int | None = None):
@@ -283,6 +306,9 @@ class NakamaServer:
             if grace_seconds is None
             else grace_seconds
         )
+        if self.grpc is not None:
+            await self.grpc.stop()
+            self.grpc = None
         await self.console.stop()
         await self.api.stop()
         await self.match_registry.stop_all(grace)

@@ -38,10 +38,22 @@ class SocialProfile:
 
 
 class SocialClient:
-    """Interface; one async verify method per provider."""
+    """Interface; one async verify method per provider, plus friend-list
+    fetchers for the social-graph import flows (reference social.go
+    GetFacebookFriends / GetSteamFriends)."""
 
     async def verify_facebook(self, token: str) -> SocialProfile:
         raise SocialError("facebook verification unavailable")
+
+    async def fetch_facebook_friends(self, token: str) -> list[str]:
+        """Provider ids of the token-holder's friends who also use the
+        app (Graph /me/friends only returns app users)."""
+        raise SocialError("facebook friends unavailable")
+
+    async def fetch_steam_friends(
+        self, publisher_key: str, steam_id: str
+    ) -> list[str]:
+        raise SocialError("steam friends unavailable")
 
     async def verify_facebook_instant(
         self, app_secret: str, signed_player_info: str
@@ -115,9 +127,13 @@ class HttpSocialClient(SocialClient):
     APPLE_JWKS = "https://appleid.apple.com/auth/keys"
     APPLE_ISSUERS = ("https://appleid.apple.com",)
     FACEBOOK_GRAPH = "https://graph.facebook.com/v11.0/me"
+    FACEBOOK_FRIENDS = "https://graph.facebook.com/v11.0/me/friends"
     STEAM_AUTH = (
         "https://partner.steam-api.com/ISteamUserAuth/"
         "AuthenticateUserTicket/v1/"
+    )
+    STEAM_FRIENDS = (
+        "https://partner.steam-api.com/ISteamUser/GetFriendList/v1/"
     )
 
     def __init__(self, fetch=None, jwks_ttl_sec: float = 3600.0):
@@ -218,6 +234,70 @@ class HttpSocialClient(SocialClient):
             email=data.get("email", ""),
         )
 
+    async def fetch_facebook_friends(self, token: str) -> list[str]:
+        """Paginated Graph friends walk (reference social.go:283
+        GetFacebookFriends follows paging.next)."""
+        import urllib.parse
+
+        url = (
+            f"{self.FACEBOOK_FRIENDS}"
+            f"?access_token={urllib.parse.quote(token, safe='')}"
+        )
+        ids: list[str] = []
+        for _ in range(32):  # runaway-paging guard
+            status, body = await self._fetch(url)
+            if status != 200:
+                raise SocialError(
+                    f"facebook friends fetch failed: HTTP {status}"
+                )
+            try:
+                data = json.loads(body)
+            except ValueError as e:
+                raise SocialError(
+                    "facebook graph returned invalid JSON"
+                ) from e
+            ids.extend(
+                str(f["id"]) for f in data.get("data", []) if f.get("id")
+            )
+            url = (data.get("paging") or {}).get("next") or ""
+            if not url:
+                break
+        else:
+            import logging
+
+            logging.getLogger("nakama_tpu.social").warning(
+                "facebook friends import truncated at 32 pages"
+                " (%d ids fetched); remaining friends skipped",
+                len(ids),
+            )
+        return ids
+
+    async def fetch_steam_friends(
+        self, publisher_key: str, steam_id: str
+    ) -> list[str]:
+        """ISteamUser friend list (reference social.go:653
+        GetSteamFriends)."""
+        import urllib.parse
+
+        if not publisher_key:
+            raise SocialError("steam not configured")
+        q = urllib.parse.urlencode(
+            {
+                "key": publisher_key,
+                "steamid": steam_id,
+                "relationship": "friend",
+            }
+        )
+        status, body = await self._fetch(f"{self.STEAM_FRIENDS}?{q}")
+        if status != 200:
+            raise SocialError(f"steam friends fetch failed: HTTP {status}")
+        try:
+            data = json.loads(body)
+        except ValueError as e:
+            raise SocialError("steam returned invalid JSON") from e
+        friends = (data.get("friendslist") or {}).get("friends") or []
+        return [str(f["steamid"]) for f in friends if f.get("steamid")]
+
     async def verify_steam(
         self, app_id: int, publisher_key: str, token: str
     ) -> SocialProfile:
@@ -298,9 +378,24 @@ class StubSocialClient(SocialClient):
 
     def __init__(self):
         self._known: dict[tuple[str, str], SocialProfile] = {}
+        self._friends: dict[tuple[str, str], list[str]] = {}
 
     def register(self, provider: str, token: str, profile: SocialProfile):
         self._known[(provider, token)] = profile
+
+    def register_friends(
+        self, provider: str, key: str, provider_ids: list[str]
+    ):
+        """key = access token for facebook, steam_id for steam."""
+        self._friends[(provider, key)] = list(provider_ids)
+
+    async def fetch_facebook_friends(self, token: str) -> list[str]:
+        return list(self._friends.get(("facebook", token), []))
+
+    async def fetch_steam_friends(
+        self, publisher_key: str, steam_id: str
+    ) -> list[str]:
+        return list(self._friends.get(("steam", steam_id), []))
 
     def _lookup(self, provider: str, token: str) -> SocialProfile:
         profile = self._known.get((provider, token))

@@ -112,6 +112,38 @@ class Database:
 
         return await self._with_conn(_migrate)
 
+    async def migrate_down(self, limit: int = 1) -> list[str]:
+        """Revert the newest `limit` applied migrations (reference
+        migrate/migrate.go:108 `down`): derived DROPs run newest-first,
+        then the migration_info rows are removed."""
+        from .migrations import down_statements
+
+        by_version = {v: (name, stmts) for v, name, stmts in MIGRATIONS}
+
+        def _down(conn: sqlite3.Connection) -> list[str]:
+            rows = conn.execute(
+                "SELECT version FROM migration_info"
+                " ORDER BY version DESC LIMIT ?",
+                (limit,),
+            ).fetchall()
+            reverted = []
+            for (version,) in rows:
+                entry = by_version.get(version)
+                if entry is None:  # unknown to this binary: leave it
+                    continue
+                name, stmts = entry
+                for stmt in down_statements(version, stmts):
+                    conn.execute(stmt)
+                conn.execute(
+                    "DELETE FROM migration_info WHERE version = ?",
+                    (version,),
+                )
+                reverted.append(name)
+            conn.commit()
+            return reverted
+
+        return await self._with_conn(_down)
+
     # ----------------------------------------------------------- operations
 
     async def execute(self, sql: str, params: Iterable[Any] = ()) -> int:

@@ -9,7 +9,45 @@ JSONB as TEXT holding JSON, timestamps as REAL unix seconds. Each entry is
 (version, name, [statements]); applied in order, tracked in `migration_info`
 the way the reference's sql-migrate tracks `migration_info`
 (reference migrate/migrate.go).
+
+Down-migrations (reference migrate/migrate.go:108-111 `down`/`redo`) are
+DERIVED rather than hand-written: every statement here is a CREATE TABLE /
+CREATE INDEX, so the inverse is the reversed list of DROPs —
+`down_statements()` parses the created object names out of the up
+statements. A future migration that ALTERs instead of CREATEs must carry
+an explicit down list via `EXPLICIT_DOWNS`.
 """
+
+import re
+
+# version -> explicit down statements, for migrations whose inverse is not
+# mechanically derivable (none yet).
+EXPLICIT_DOWNS: dict[int, list[str]] = {}
+
+_CREATE_RE = re.compile(
+    r"CREATE\s+(TABLE|INDEX)\s+(?:IF\s+NOT\s+EXISTS\s+)?([A-Za-z_][\w]*)",
+    re.IGNORECASE,
+)
+
+
+def down_statements(version: int, statements: list[str]) -> list[str]:
+    """The inverse of one migration: DROPs of everything it created, in
+    reverse order (indexes drop with their tables in SQLite, but explicit
+    DROP INDEX keeps the list faithful)."""
+    explicit = EXPLICIT_DOWNS.get(version)
+    if explicit is not None:
+        return explicit
+    drops: list[str] = []
+    for stmt in reversed(statements):
+        m = _CREATE_RE.search(stmt)
+        if m is None:
+            raise ValueError(
+                f"migration v{version} statement is not mechanically "
+                f"invertible; add EXPLICIT_DOWNS[{version}]: {stmt[:60]!r}"
+            )
+        kind, obj = m.group(1).upper(), m.group(2)
+        drops.append(f"DROP {kind} IF EXISTS {obj}")
+    return drops
 
 MIGRATIONS: list[tuple[int, str, list[str]]] = [
     (

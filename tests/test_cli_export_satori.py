@@ -153,3 +153,41 @@ async def test_google_refund_scheduler_marks_and_hooks():
     # Second sweep is idempotent.
     assert await sched.poll_once() == 0
     await db.close()
+
+
+async def test_migrate_down_and_redo():
+    """migrate down/redo (VERDICT r2 #6, reference migrate/migrate.go:
+    108-111): down reverts the newest migration with derived DROPs,
+    redo re-applies it."""
+    db = Database(":memory:")
+    await db.connect()
+    before = [r["name"] for r in await migrate_status(db)]
+    assert before  # full stack applied
+
+    reverted = await db.migrate_down(1)
+    assert reverted == [before[-1]]
+    after = [r["name"] for r in await migrate_status(db)]
+    assert after == before[:-1]
+    # The newest migration's table is gone.
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        await db.fetch_one("SELECT 1 FROM purchase_receipt LIMIT 1")
+
+    # Redo = down + up: re-applying restores the table.
+    applied = await db.migrate()
+    assert applied == [before[-1]]
+    assert await db.fetch_one("SELECT COUNT(*) AS n FROM purchase_receipt")
+    assert [r["name"] for r in await migrate_status(db)] == before
+    await db.close()
+
+
+async def test_down_statements_derived_for_all_migrations():
+    """Every embedded migration must be mechanically invertible (or carry
+    an explicit down) — guards future ALTER-style migrations."""
+    from nakama_tpu.storage.migrations import MIGRATIONS, down_statements
+
+    for version, _, stmts in MIGRATIONS:
+        drops = down_statements(version, stmts)
+        assert len(drops) == len(stmts)
+        assert all(d.startswith("DROP ") for d in drops)

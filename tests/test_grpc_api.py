@@ -1,0 +1,248 @@
+"""gRPC front door (VERDICT r2 #3): the NakamaApi service served by the
+transcoding gateway (api/grpc_server.py) against a live server — typed
+proto requests/responses over a real grpc channel, auth via metadata,
+REST-equivalent behavior including hooks and error codes.
+
+No generated client stubs needed: methods are invoked via
+channel.unary_unary with the proto serializers, the same wire a real SDK
+client produces.
+"""
+
+import base64
+
+import grpc
+import pytest
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.proto import api_pb2 as P
+from nakama_tpu.server import NakamaServer
+
+async def make_server(modules=None):
+    config = Config()
+    config.socket.port = 0
+    server = NakamaServer(
+        config, quiet_logger(), runtime_modules=modules or []
+    )
+    await server.start()
+    return server
+
+
+class Client:
+    def __init__(self, server):
+        self.channel = grpc.aio.insecure_channel(
+            f"127.0.0.1:{server.grpc_port}"
+        )
+
+    async def close(self):
+        await self.channel.close()
+
+    async def call(self, method, request, response_type, auth=""):
+        fn = self.channel.unary_unary(
+            f"/nakama_tpu.api.NakamaApi/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_type.FromString,
+        )
+        metadata = (("authorization", auth),) if auth else ()
+        return await fn(request, metadata=metadata)
+
+
+def server_key_auth(key="defaultkey"):
+    return "Basic " + base64.b64encode(f"{key}:".encode()).decode()
+
+
+async def test_grpc_authenticate_account_storage_flow():
+    server = await make_server()
+    c = Client(server)
+    try:
+        # Authenticate (server-key Basic auth, like the reference's
+        # authenticate interceptor).
+        req = P.AuthenticateRequest(username="grpcuser")
+        req.account.update({"id": "device-grpc-000001"})
+        session = await c.call(
+            "AuthenticateDevice", req, P.Session, auth=server_key_auth()
+        )
+        assert session.token and session.refresh_token
+        bearer = f"Bearer {session.token}"
+
+        # Account round-trip.
+        account = await c.call("GetAccount", P.Empty(), P.Account,
+                               auth=bearer)
+        assert account.user.username == "grpcuser"
+        assert account.devices[0].id == "device-grpc-000001"
+
+        await c.call(
+            "UpdateAccount",
+            P.UpdateAccountRequest(display_name="G. RPC"),
+            P.Empty,
+            auth=bearer,
+        )
+        account = await c.call("GetAccount", P.Empty(), P.Account,
+                               auth=bearer)
+        assert account.user.display_name == "G. RPC"
+
+        # Storage write/read/list with OCC versions.
+        w = P.WriteStorageObjectsRequest()
+        w.objects.add(
+            collection="saves", key="slot1", value='{"hp": 10}',
+            permission_read=2, permission_write=1,
+        )
+        acks = await c.call(
+            "WriteStorageObjects", w, P.StorageObjectAcks, auth=bearer
+        )
+        assert acks.acks[0].version
+
+        r = P.ReadStorageObjectsRequest()
+        r.object_ids.add(collection="saves", key="slot1")
+        objs = await c.call(
+            "ReadStorageObjects", r, P.StorageObjects, auth=bearer
+        )
+        assert objs.objects[0].value == '{"hp": 10}'
+        assert objs.objects[0].version == acks.acks[0].version
+
+        listing = await c.call(
+            "ListStorageObjects",
+            P.ListStorageObjectsRequest(collection="saves", limit=10),
+            P.StorageObjectList,
+            auth=bearer,
+        )
+        assert len(listing.objects) == 1
+    finally:
+        await c.close()
+        await server.stop()
+
+
+async def test_grpc_auth_errors_map_to_status_codes():
+    server = await make_server()
+    c = Client(server)
+    try:
+        # Wrong server key -> UNAUTHENTICATED.
+        req = P.AuthenticateRequest()
+        req.account.update({"id": "device-grpc-000002"})
+        with pytest.raises(grpc.aio.AioRpcError) as err:
+            await c.call(
+                "AuthenticateDevice", req, P.Session,
+                auth=server_key_auth("wrongkey"),
+            )
+        assert err.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+        # Missing bearer -> UNAUTHENTICATED.
+        with pytest.raises(grpc.aio.AioRpcError) as err:
+            await c.call("GetAccount", P.Empty(), P.Account)
+        assert err.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+        # create=false on an unknown device -> NOT_FOUND (the BoolValue
+        # wrapper must carry the explicit false through the transcode).
+        from google.protobuf import wrappers_pb2
+
+        req2 = P.AuthenticateRequest(
+            create=wrappers_pb2.BoolValue(value=False)
+        )
+        req2.account.update({"id": "device-grpc-does-not-exist"})
+        with pytest.raises(grpc.aio.AioRpcError) as err:
+            await c.call(
+                "AuthenticateDevice", req2, P.Session,
+                auth=server_key_auth(),
+            )
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await c.close()
+        await server.stop()
+
+
+async def test_grpc_rpc_func_and_friends():
+    def init_module(ctx, logger, nk, initializer):
+        def echo(ctx, payload):
+            return payload.upper()
+
+        initializer.register_rpc("echo", echo)
+
+    server = await make_server(modules=[init_module])
+    c = Client(server)
+    try:
+        req = P.AuthenticateRequest()
+        req.account.update({"id": "device-grpc-000003"})
+        s1 = await c.call(
+            "AuthenticateDevice", req, P.Session, auth=server_key_auth()
+        )
+        req = P.AuthenticateRequest()
+        req.account.update({"id": "device-grpc-000004"})
+        req.username = "grpcfriend"
+        await c.call(
+            "AuthenticateDevice", req, P.Session, auth=server_key_auth()
+        )
+        bearer = f"Bearer {s1.token}"
+
+        out = await c.call(
+            "RpcFunc", P.Rpc(id="echo", payload="hello"), P.Rpc,
+            auth=bearer,
+        )
+        assert out.payload == "HELLO"
+
+        await c.call(
+            "AddFriends",
+            P.AddFriendsRequest(usernames=["grpcfriend"]),
+            P.Empty,
+            auth=bearer,
+        )
+        friends = await c.call(
+            "ListFriends", P.ListFriendsRequest(limit=10), P.FriendList,
+            auth=bearer,
+        )
+        assert len(friends.friends) == 1
+        assert friends.friends[0].user.username == "grpcfriend"
+    finally:
+        await c.close()
+        await server.stop()
+
+
+async def test_grpc_subscription_validate_and_get():
+    import json as _json
+
+    server = await make_server()
+    server.config.iap.apple_shared_password = "shhh"
+
+    async def apple_sub_fetch(url, method="GET", headers=None, body=None):
+        return 200, _json.dumps(
+            {
+                "status": 0,
+                "latest_receipt_info": [
+                    {
+                        "original_transaction_id": "grpc-sub-1",
+                        "product_id": "vip.yearly",
+                        "purchase_date_ms": "1700000000000",
+                        "expires_date_ms": "99999999999000",
+                    }
+                ],
+            }
+        ).encode()
+
+    server.purchases._fetch = apple_sub_fetch
+    c = Client(server)
+    try:
+        req = P.AuthenticateRequest()
+        req.account.update({"id": "device-grpc-000005"})
+        s = await c.call(
+            "AuthenticateDevice", req, P.Session, auth=server_key_auth()
+        )
+        bearer = f"Bearer {s.token}"
+        out = await c.call(
+            "ValidateSubscriptionApple",
+            P.ValidateSubscriptionRequest(receipt="b64receipt"),
+            P.ValidateSubscriptionResponse,
+            auth=bearer,
+        )
+        assert out.validated_subscription.product_id == "vip.yearly"
+        assert out.validated_subscription.active
+
+        got = await c.call(
+            "GetSubscription",
+            P.GetSubscriptionRequest(original_transaction_id="grpc-sub-1"),
+            P.ValidatedSubscription,
+            auth=bearer,
+        )
+        assert got.original_transaction_id == "grpc-sub-1"
+    finally:
+        await c.close()
+        await server.stop()

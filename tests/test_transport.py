@@ -246,3 +246,84 @@ async def test_session_disconnect_cleans_up():
             await asyncio.sleep(0.01)
         assert len(h.sessions) == 0
         assert h.tracker.count() == 0
+
+
+# ------------------------------------------------------- protobuf format
+
+
+async def recv_until_pb(ws, key, timeout=5.0):
+    from nakama_tpu.api import protocol
+
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        raw = await asyncio.wait_for(ws.recv(), timeout=max(0.01, remaining))
+        assert isinstance(raw, bytes), "protobuf socket must send binary"
+        envelope = protocol.decode(raw, "protobuf")
+        if key in envelope:
+            return envelope
+
+
+async def test_ws_protobuf_ping_roundtrip():
+    from nakama_tpu.api import protocol
+
+    async with Harness() as h:
+        ws = await websockets.connect(
+            h.url(h.token_for("u1", "alice"), format="protobuf")
+        )
+        await ws.send(protocol.encode({"cid": "1", "ping": {}}, "protobuf"))
+        pong = await recv_until_pb(ws, "pong")
+        assert pong["cid"] == "1"
+        await ws.close()
+
+
+async def test_end_to_end_matchmaking_protobuf_and_mixed_formats():
+    """VERDICT r2 #3 done-criterion: the socket round-trip in BOTH
+    formats — one client on protobuf, one on JSON, matched together;
+    each receives the same match token in its own encoding."""
+    from nakama_tpu.api import protocol
+
+    async with Harness() as h:
+        a = await websockets.connect(
+            h.url(h.token_for("u1", "alice"), format="protobuf")
+        )
+        b = await websockets.connect(h.url(h.token_for("u2", "bob")))
+        add = {
+            "cid": "mm",
+            "matchmaker_add": {
+                "min_count": 2,
+                "max_count": 2,
+                "query": "+properties.mode:duel",
+                "string_properties": {"mode": "duel"},
+            },
+        }
+        await a.send(protocol.encode(add, "protobuf"))
+        ticket_a = await recv_until_pb(a, "matchmaker_ticket")
+        assert ticket_a["matchmaker_ticket"]["ticket"]
+        await b.send(json.dumps(add))
+        ticket_b = await recv_until(b, "matchmaker_ticket")
+        assert ticket_b["matchmaker_ticket"]["ticket"]
+
+        h.matchmaker.process()
+
+        m_a = await recv_until_pb(a, "matchmaker_matched")
+        m_b = await recv_until(b, "matchmaker_matched")
+        assert m_a["matchmaker_matched"]["token"] == m_b[
+            "matchmaker_matched"
+        ]["token"]
+        users = {
+            u["presence"]["username"]
+            for u in m_a["matchmaker_matched"]["users"]
+        }
+        assert users == {"alice", "bob"}
+        await a.close()
+        await b.close()
+
+
+async def test_ws_unsupported_format_rejected():
+    async with Harness() as h:
+        with pytest.raises(websockets.ConnectionClosed):
+            ws = await websockets.connect(
+                h.url(h.token_for("u1", "alice"), format="msgpack")
+            )
+            await ws.recv()
