@@ -5,11 +5,16 @@ port, own JWT signing key, authentication against the configured root
 admin (config console.username/password) or `console_user` rows with
 role-based access and login-attempt lockout (console_authenticate.go:73),
 and the operator surface of the console_*.go handlers: account browse/
-edit/ban, storage browse/edit, match listing + live state view
-(match_registry GetState, console uses it), leaderboard browse, purchase
-browse, redacted config view, runtime info (loaded modules + rpc ids),
-and a status snapshot fed by the metrics registry (status_handler.go:64).
-The reference embeds an Angular UI; the JSON API is the contract here.
+edit (profile + metadata + wallet replacement)/ban/export/delete, wallet
+ledger view, storage browse/write/delete + bulk CSV/JSON import
+(console_storage_import.go), group browse + member lists, match listing
++ live state view (match_registry GetState), leaderboard browse,
+purchase browse, console-user management with role enforcement
+(console_user.go), redacted config view + warnings, runtime info (loaded
+modules + rpc ids), an RPC explorer, and a status snapshot fed by the
+metrics registry (status_handler.go:64). The reference embeds an Angular
+build (console/ui.go:24); here `/` serves a dependency-free operator
+page over the same JSON API (console/ui.py).
 """
 
 from __future__ import annotations
@@ -52,8 +57,13 @@ class ConsoleServer:
         r.add_get("/v2/console/status", self._h_status)
         r.add_get("/v2/console/config", self._h_config)
         r.add_get("/v2/console/runtime", self._h_runtime)
+        r.add_get("/", self._h_ui)
         r.add_get("/v2/console/account", self._h_account_list)
         r.add_get("/v2/console/account/{id}", self._h_account_get)
+        r.add_post("/v2/console/account/{id}", self._h_account_update)
+        r.add_get(
+            "/v2/console/account/{id}/wallet", self._h_account_wallet
+        )
         r.add_post("/v2/console/account/{id}/ban", self._h_account_ban)
         r.add_post("/v2/console/account/{id}/unban", self._h_account_unban)
         r.add_delete("/v2/console/account/{id}", self._h_account_delete)
@@ -61,9 +71,17 @@ class ConsoleServer:
             "/v2/console/account/{id}/export", self._h_account_export
         )
         r.add_get("/v2/console/storage", self._h_storage_list)
+        r.add_post("/v2/console/storage", self._h_storage_write)
+        r.add_post(
+            "/v2/console/storage/import", self._h_storage_import
+        )
         r.add_get(
             "/v2/console/storage/{collection}/{key}/{user_id}",
             self._h_storage_get,
+        )
+        r.add_delete(
+            "/v2/console/storage/{collection}/{key}/{user_id}",
+            self._h_storage_delete,
         )
         r.add_get("/v2/console/match", self._h_match_list)
         r.add_get("/v2/console/matchmaker", self._h_matchmaker)
@@ -72,7 +90,14 @@ class ConsoleServer:
         r.add_get(
             "/v2/console/leaderboard/{id}", self._h_leaderboard_records
         )
+        r.add_get("/v2/console/group", self._h_group_list)
+        r.add_get("/v2/console/group/{id}/member", self._h_group_members)
         r.add_get("/v2/console/purchase", self._h_purchase_list)
+        r.add_get("/v2/console/user", self._h_console_user_list)
+        r.add_post("/v2/console/user", self._h_console_user_create)
+        r.add_delete(
+            "/v2/console/user/{username}", self._h_console_user_delete
+        )
         r.add_post("/v2/console/api/endpoints/rpc/{id}", self._h_call_rpc)
 
     # ----------------------------------------------------------- lifecycle
@@ -181,6 +206,13 @@ class ConsoleServer:
 
     # -------------------------------------------------------------- status
 
+    async def _h_ui(self, request: web.Request):
+        """Embedded operator UI (reference embeds an Angular build,
+        console/ui.go:24; here one static page over the JSON API)."""
+        from .ui import PAGE
+
+        return web.Response(text=PAGE, content_type="text/html")
+
     async def _h_metrics(self, request: web.Request):
         return web.Response(
             body=self.server.metrics.scrape(),
@@ -283,6 +315,67 @@ class ConsoleServer:
         account["wallet"] = wallet
         return web.json_response(account)
 
+    async def _h_account_update(self, request: web.Request):
+        """Operator account edit (reference console_account.go
+        UpdateAccount): profile fields, metadata, wallet replacement —
+        each optional, absent leaves untouched."""
+        self._auth(request, write=True)
+        from ..core import account as core_account
+
+        user_id = request.match_info["id"]
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        # Existence check up front: a wallet-only body would otherwise
+        # slip past update_account's no-op early return and the 0-row
+        # UPDATE, 200-ing an edit that never landed.
+        exists = await self.server.db.fetch_one(
+            "SELECT 1 FROM users WHERE id = ?", (user_id,)
+        )
+        if exists is None:
+            return _err(404, "account not found")
+        try:
+            await core_account.update_account(
+                self.server.db,
+                user_id,
+                username=body.get("username"),
+                display_name=body.get("display_name"),
+                timezone=body.get("timezone"),
+                location=body.get("location"),
+                lang_tag=body.get("lang_tag"),
+                avatar_url=body.get("avatar_url"),
+                metadata=body.get("metadata"),
+            )
+            if "wallet" in body:
+                wallet = body["wallet"]
+                if not isinstance(wallet, dict):
+                    return _err(400, "wallet must be a JSON object")
+                await self.server.db.execute(
+                    "UPDATE users SET wallet = ? WHERE id = ?",
+                    (json.dumps(wallet), user_id),
+                )
+        except core_auth.AuthError as e:
+            return _err(404, str(e))
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({})
+
+    async def _h_account_wallet(self, request: web.Request):
+        """Wallet + ledger page (reference console_account.go
+        GetWalletLedger)."""
+        self._auth(request)
+        user_id = request.match_info["id"]
+        wallet = await self.server.wallets.get(user_id)
+        items, cursor = await self.server.wallets.list_ledger(
+            user_id,
+            limit=int(request.query.get("limit", 100)),
+            cursor=request.query.get("cursor", ""),
+        )
+        return web.json_response(
+            {"wallet": wallet, "ledger": items, "cursor": cursor}
+        )
+
     async def _h_account_ban(self, request: web.Request):
         self._auth(request, write=True)
         user_id = request.match_info["id"]
@@ -360,6 +453,123 @@ class ConsoleServer:
         if row is None:
             return _err(404, "object not found")
         return web.json_response(dict(row))
+
+    async def _h_storage_write(self, request: web.Request):
+        """Operator storage write (reference console_storage.go
+        WriteStorageObject): system-caller semantics, any owner."""
+        self._auth(request, write=True)
+        from ..core.storage import StorageOpWrite, storage_write_objects
+
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        value = body.get("value", "")
+        if not isinstance(value, str):
+            value = json.dumps(value)
+        try:
+            acks = await storage_write_objects(
+                self.server.db,
+                None,  # system caller: permission/ownership bypass
+                [
+                    StorageOpWrite(
+                        collection=body.get("collection", ""),
+                        key=body.get("key", ""),
+                        user_id=body.get("user_id", ""),
+                        value=value,
+                        version=body.get("version", ""),
+                        permission_read=int(
+                            body.get("permission_read", 1)
+                        ),
+                        permission_write=int(
+                            body.get("permission_write", 1)
+                        ),
+                    )
+                ],
+            )
+        except Exception as e:
+            return _err(400, str(e))
+        import dataclasses
+
+        return web.json_response(dataclasses.asdict(acks[0]))
+
+    async def _h_storage_delete(self, request: web.Request):
+        self._auth(request, write=True)
+        from ..core.storage import (
+            StorageOpDelete,
+            storage_delete_objects,
+        )
+
+        try:
+            await storage_delete_objects(
+                self.server.db,
+                None,
+                [
+                    StorageOpDelete(
+                        collection=request.match_info["collection"],
+                        key=request.match_info["key"],
+                        user_id=request.match_info["user_id"],
+                    )
+                ],
+            )
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({})
+
+    async def _h_storage_import(self, request: web.Request):
+        """Bulk storage import, JSON array or CSV (reference
+        console_storage_import.go: importStorage accepts both upload
+        formats). JSON: a list of objects with collection/key/user_id/
+        value[/permission_read/permission_write]. CSV: a header row
+        naming those columns. Rows import in ONE transaction — an import
+        either lands whole or not at all (reference behaviour)."""
+        self._auth(request, write=True)
+        from ..core.storage import StorageOpWrite, storage_write_objects
+
+        raw = await request.text()
+        ctype = request.content_type or ""
+        rows: list[dict] = []
+        try:
+            if "csv" in ctype or (
+                not raw.lstrip().startswith(("[", "{"))
+            ):
+                import csv as _csv
+                import io as _io
+
+                reader = _csv.DictReader(_io.StringIO(raw))
+                for rec in reader:
+                    rows.append(dict(rec))
+            else:
+                data = json.loads(raw)
+                if not isinstance(data, list):
+                    return _err(400, "JSON import must be an array")
+                rows = data
+        except Exception as e:
+            return _err(400, f"unparseable import: {e}")
+        ops = []
+        for rec in rows:
+            value = rec.get("value", "")
+            if not isinstance(value, str):
+                value = json.dumps(value)
+            ops.append(
+                StorageOpWrite(
+                    collection=rec.get("collection", ""),
+                    key=rec.get("key", ""),
+                    user_id=rec.get("user_id", "") or "",
+                    value=value,
+                    permission_read=int(rec.get("permission_read", 1) or 1),
+                    permission_write=int(
+                        rec.get("permission_write", 1) or 1
+                    ),
+                )
+            )
+        if not ops:
+            return _err(400, "no rows to import")
+        try:
+            acks = await storage_write_objects(self.server.db, None, ops)
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({"imported": len(acks)})
 
     # ------------------------------------------------------------- matches
 
@@ -443,6 +653,99 @@ class ConsoleServer:
         )
 
     # --------------------------------------------------------------- rpc
+
+    async def _h_group_list(self, request: web.Request):
+        """Group browse (reference console_group.go ListGroups)."""
+        self._auth(request)
+        q = request.query
+        result = await self.server.groups.list(
+            name=q.get("name") or None,
+            limit=int(q.get("limit", 100)),
+            cursor=q.get("cursor", ""),
+        )
+        return web.json_response(result)
+
+    async def _h_group_members(self, request: web.Request):
+        self._auth(request)
+        from ..core.group import GroupError
+
+        try:
+            result = await self.server.groups.users_list(
+                request.match_info["id"],
+                limit=int(request.query.get("limit", 100)),
+                cursor=request.query.get("cursor", ""),
+            )
+        except GroupError as e:
+            return _err(404, str(e))
+        return web.json_response(result)
+
+    # -------------------------------------------------------- console users
+
+    async def _h_console_user_list(self, request: web.Request):
+        self._auth(request)
+        rows = await self.server.db.fetch_all(
+            "SELECT username, email, role, create_time, disable_time"
+            " FROM console_user ORDER BY username"
+        )
+        return web.json_response({"users": [dict(r) for r in rows]})
+
+    async def _h_console_user_create(self, request: web.Request):
+        """Operator account provisioning (reference console_user.go
+        AddUser): admin-only."""
+        role = self._auth(request, write=True)
+        if role != ROLE_ADMIN:
+            return _err(403, "admin role required")
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        username = body.get("username", "")
+        password = body.get("password", "")
+        if not username or len(password) < 8:
+            return _err(
+                400, "username and password (>= 8 chars) required"
+            )
+        new_role = int(body.get("role", ROLE_READONLY))
+        if new_role not in (
+            ROLE_ADMIN, ROLE_DEVELOPER, ROLE_MAINTAINER, ROLE_READONLY
+        ):
+            return _err(400, "invalid role")
+        import uuid as _uuid
+
+        from ..storage.db import UniqueViolationError
+
+        try:
+            await self.server.db.execute(
+                "INSERT INTO console_user (id, username, email, password,"
+                " role, create_time, update_time, disable_time)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, 0)",
+                (
+                    str(_uuid.uuid4()),
+                    username,
+                    # email is NOT NULL UNIQUE; synthesize one if absent
+                    # so two email-less operators don't collide on "".
+                    body.get("email") or f"{username}@console.local",
+                    core_auth.hash_password(password),
+                    new_role,
+                    time.time(),
+                    time.time(),
+                ),
+            )
+        except UniqueViolationError:
+            return _err(409, "username already exists")
+        return web.json_response({"username": username, "role": new_role})
+
+    async def _h_console_user_delete(self, request: web.Request):
+        role = self._auth(request, write=True)
+        if role != ROLE_ADMIN:
+            return _err(403, "admin role required")
+        n = await self.server.db.execute(
+            "DELETE FROM console_user WHERE username = ?",
+            (request.match_info["username"],),
+        )
+        if not n:
+            return _err(404, "console user not found")
+        return web.json_response({})
 
     async def _h_call_rpc(self, request: web.Request):
         """API explorer: invoke any registered RPC as the console

@@ -1,0 +1,242 @@
+"""Embedded operator UI — one static page over the console JSON API.
+
+The reference embeds a full Angular build (reference console/ui.go:24);
+here the JSON API is the contract and this page is a dependency-free
+operator shell for it: login, live status, account browse/edit, storage
+browse/write/import, group browse, match list, config + warnings, and an
+RPC explorer. Served at `/` on the console listener.
+"""
+
+PAGE = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>nakama-tpu console</title>
+<style>
+ body { font-family: ui-monospace, Menlo, monospace; margin: 0;
+        background: #0b1020; color: #d7e0ff; }
+ header { padding: 10px 16px; background: #141b33; display: flex;
+          gap: 16px; align-items: baseline; }
+ header h1 { font-size: 16px; margin: 0; color: #8ab4ff; }
+ nav button, .bar button, form button {
+   background: #1d2747; color: #d7e0ff; border: 1px solid #31407a;
+   padding: 4px 10px; cursor: pointer; font: inherit; }
+ nav button.active { background: #31407a; }
+ main { padding: 16px; }
+ table { border-collapse: collapse; width: 100%; margin-top: 8px; }
+ td, th { border: 1px solid #2a3663; padding: 4px 8px; text-align: left;
+          font-size: 12px; }
+ input, textarea, select { background: #0f1630; color: #d7e0ff;
+   border: 1px solid #31407a; padding: 4px 6px; font: inherit; }
+ pre { background: #0f1630; padding: 10px; overflow: auto;
+       border: 1px solid #2a3663; }
+ .err { color: #ff8a8a; }
+ .ok { color: #8aff9e; }
+ #login { max-width: 320px; margin: 80px auto; display: flex;
+          flex-direction: column; gap: 8px; }
+</style>
+</head>
+<body>
+<div id="app"></div>
+<script>
+const $ = (h) => { const d = document.createElement('div');
+                   d.innerHTML = h; return d; };
+// EVERY server-sourced value is escaped before touching innerHTML:
+// player-controlled names/keys/metadata must never execute with the
+// operator's console token (stored-XSS).
+const esc = (v) => String(v).replace(/[&<>"']/g, (c) => ({
+  '&': '&amp;', '<': '&lt;', '>': '&gt;', '"': '&quot;', "'": '&#39;',
+})[c]);
+const jpre = (v) => `<pre>${esc(JSON.stringify(v, null, 2))}</pre>`;
+let token = sessionStorage.getItem('ctok') || '';
+const api = async (method, path, body) => {
+  const r = await fetch(path, {
+    method,
+    headers: Object.assign(
+      { 'Authorization': 'Bearer ' + token },
+      body ? { 'Content-Type': 'application/json' } : {}),
+    body: body ? JSON.stringify(body) : undefined,
+  });
+  const text = await r.text();
+  let data; try { data = JSON.parse(text); } catch { data = { raw: text }; }
+  if (!r.ok) throw new Error(data.error || r.status);
+  return data;
+};
+const app = document.getElementById('app');
+
+function loginView(msg) {
+  app.innerHTML = '';
+  const v = $(`<div id="login"><h1>nakama-tpu console</h1>
+    <input id="u" placeholder="username">
+    <input id="p" type="password" placeholder="password">
+    <button id="go">Sign in</button>
+    <div class="err">${esc(msg || '')}</div></div>`);
+  v.querySelector('#go').onclick = async () => {
+    try {
+      const r = await fetch('/v2/console/authenticate', {
+        method: 'POST', headers: { 'Content-Type': 'application/json' },
+        body: JSON.stringify({ username: v.querySelector('#u').value,
+                               password: v.querySelector('#p').value })});
+      const d = await r.json();
+      if (!r.ok) throw new Error(d.error || r.status);
+      token = d.token; sessionStorage.setItem('ctok', token); mainView();
+    } catch (e) { loginView(e.message); }
+  };
+  app.appendChild(v);
+}
+
+const TABS = {
+  status: async (el) => {
+    const s = await api('GET', '/v2/console/status');
+    el.appendChild($(jpre(s)));
+  },
+  accounts: async (el) => {
+    const d = await api('GET', '/v2/console/account?limit=50');
+    const rows = d.users.map(u =>
+      `<tr><td><a href="#" data-id="${esc(u.id)}">${esc(u.id)}</a></td>
+       <td>${esc(u.username)}</td><td>${esc(u.create_time)}</td></tr>`)
+      .join('');
+    el.appendChild($(`<table><tr><th>id</th><th>username</th>
+      <th>created</th></tr>${rows}</table><div id="detail"></div>`));
+    el.querySelectorAll('a[data-id]').forEach(a => a.onclick = async (e) => {
+      e.preventDefault();
+      const id = a.dataset.id;
+      const acct = await api('GET', '/v2/console/account/' + id);
+      const w = await api('GET', `/v2/console/account/${id}/wallet`);
+      const det = el.querySelector('#detail');
+      det.innerHTML = `<h3>${esc(id)}</h3>
+        ${jpre(acct)}
+        <h4>wallet / ledger</h4>${jpre(w)}
+        <h4>edit</h4>
+        <input id="dn" placeholder="display_name">
+        <button id="save">Save</button> <span id="r"></span>`;
+      det.querySelector('#save').onclick = async () => {
+        try {
+          await api('POST', '/v2/console/account/' + id,
+                    { display_name: det.querySelector('#dn').value });
+          det.querySelector('#r').innerHTML = '<span class="ok">saved</span>';
+        } catch (err) {
+          det.querySelector('#r').innerHTML =
+            `<span class="err">${esc(err.message)}</span>`;
+        }
+      };
+    });
+  },
+  storage: async (el) => {
+    const d = await api('GET', '/v2/console/storage?limit=50');
+    const rows = d.objects.map(o =>
+      `<tr><td>${esc(o.collection)}</td><td>${esc(o.key)}</td>
+       <td>${esc(o.user_id)}</td><td>${esc(o.version)}</td></tr>`)
+      .join('');
+    el.appendChild($(`
+      <div class="bar">
+        <h4>write object</h4>
+        <input id="c" placeholder="collection">
+        <input id="k" placeholder="key">
+        <input id="u" placeholder="user_id">
+        <input id="v" placeholder='{"json": "value"}' size="32">
+        <button id="w">Write</button>
+        <h4>import (JSON array or CSV)</h4>
+        <textarea id="imp" rows="4" cols="60"></textarea>
+        <button id="doimp">Import</button> <span id="r"></span>
+      </div>
+      <table><tr><th>collection</th><th>key</th><th>owner</th>
+      <th>version</th></tr>${rows}</table>`));
+    el.querySelector('#w').onclick = async () => {
+      try {
+        await api('POST', '/v2/console/storage', {
+          collection: el.querySelector('#c').value,
+          key: el.querySelector('#k').value,
+          user_id: el.querySelector('#u').value,
+          value: el.querySelector('#v').value });
+        el.querySelector('#r').innerHTML = '<span class="ok">written</span>';
+      } catch (e) {
+        el.querySelector('#r').innerHTML =
+          `<span class="err">${esc(e.message)}</span>`;
+      }
+    };
+    el.querySelector('#doimp').onclick = async () => {
+      try {
+        const r = await fetch('/v2/console/storage/import', {
+          method: 'POST',
+          headers: { 'Authorization': 'Bearer ' + token },
+          body: el.querySelector('#imp').value });
+        const d2 = await r.json();
+        if (!r.ok) throw new Error(d2.error || r.status);
+        el.querySelector('#r').innerHTML =
+          `<span class="ok">imported ${d2.imported}</span>`;
+      } catch (e) {
+        el.querySelector('#r').innerHTML =
+          `<span class="err">${esc(e.message)}</span>`;
+      }
+    };
+  },
+  groups: async (el) => {
+    const d = await api('GET', '/v2/console/group?limit=50');
+    const rows = d.groups.map(g =>
+      `<tr><td>${esc(g.id)}</td><td>${esc(g.name)}</td>
+       <td>${esc(g.edge_count)}</td><td>${esc(g.open)}</td></tr>`)
+      .join('');
+    el.appendChild($(`<table><tr><th>id</th><th>name</th><th>members</th>
+      <th>open</th></tr>${rows}</table>`));
+  },
+  matches: async (el) => {
+    const d = await api('GET', '/v2/console/match');
+    el.appendChild($(jpre(d)));
+  },
+  matchmaker: async (el) => {
+    const d = await api('GET', '/v2/console/matchmaker');
+    el.appendChild($(jpre(d)));
+  },
+  config: async (el) => {
+    const d = await api('GET', '/v2/console/config');
+    const s = await api('GET', '/v2/console/status');
+    el.appendChild($(`<h4>warnings</h4>
+      ${jpre(s.config_warnings)}
+      <h4>config (redacted)</h4>
+      ${jpre(d)}`));
+  },
+  rpc: async (el) => {
+    el.appendChild($(`<input id="id" placeholder="rpc id">
+      <textarea id="pl" rows="3" cols="50" placeholder="payload"></textarea>
+      <button id="call">Call</button><div id="out"></div>`));
+    el.querySelector('#call').onclick = async () => {
+      try {
+        const d = await api('POST', '/v2/console/api/endpoints/rpc/' +
+          el.querySelector('#id').value,
+          { payload: el.querySelector('#pl').value });
+        el.querySelector('#out').innerHTML = jpre(d);
+      } catch (e) {
+        el.querySelector('#out').innerHTML =
+          `<pre class="err">${esc(e.message)}</pre>`;
+      }
+    };
+  },
+};
+
+function mainView(active) {
+  active = active || 'status';
+  app.innerHTML = '';
+  const nav = $(`<header><h1>nakama-tpu</h1><nav>` +
+    Object.keys(TABS).map(t =>
+      `<button class="${t === active ? 'active' : ''}" data-t="${t}">` +
+      `${t}</button>`).join('') +
+    `</nav><button id="out">sign out</button></header><main></main>`);
+  nav.querySelectorAll('[data-t]').forEach(b =>
+    b.onclick = () => mainView(b.dataset.t));
+  nav.querySelector('#out').onclick = () => {
+    token = ''; sessionStorage.removeItem('ctok'); loginView();
+  };
+  app.appendChild(nav);
+  const el = app.querySelector('main');
+  TABS[active](el).catch(e => {
+    if (String(e.message).includes('auth')) return loginView(e.message);
+    el.appendChild($(`<pre class="err">${esc(e.message)}</pre>`));
+  });
+}
+
+token ? mainView() : loginView();
+</script>
+</body>
+</html>
+"""

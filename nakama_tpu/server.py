@@ -53,7 +53,10 @@ class NakamaServer:
         self.db = database
         self._owns_db = database is None
         if self.db is None:
-            self.db = Database(config.database.address or [":memory:"])
+            self.db = Database(
+                config.database.address or [":memory:"],
+                read_pool_size=min(8, config.database.max_open_conns),
+            )
         self._db_connected = False
         self._runtime_modules = runtime_modules or []
 

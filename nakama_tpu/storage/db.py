@@ -2,21 +2,34 @@
 
 Plays the role of the reference's connection manager (reference
 server/db.go:35 DbConnect: multi-DSN connect, ping, version probe) for an
-embedded engine. SQLite calls are synchronous, so every operation runs on a
-single dedicated executor thread — the SQLite connection lives on that
-thread only — and transactions hold an asyncio lock for their duration,
-giving the same serialised-writer discipline the reference gets from
-Postgres transactions.
+embedded engine. Writes and transactions run on ONE dedicated executor
+thread (the writer connection lives on that thread only) and transactions
+hold an asyncio lock for their duration — the same serialised-writer
+discipline the reference gets from Postgres transactions.
+
+Reads scale past the writer thread (VERDICT r2 #7, reference's pgx pool
+db.go:35): WAL mode permits any number of readers concurrent with the
+single writer, so file-backed databases get a pool of read-only
+connections — one per reader thread — and non-transactional fetch_one /
+fetch_all run there WITHOUT the writer lock. WAL readers observe the
+last committed snapshot, so a fetch never sees another task's open
+transaction; read-your-committed-writes holds because every write path
+commits before returning. `:memory:` databases (tests) cannot share
+state across connections and quietly keep the single-threaded path.
 """
 
 from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import itertools
 import sqlite3
+import threading
 from typing import Any, Iterable
 
 from .migrations import MIGRATIONS
+
+READ_POOL_SIZE = 4
 
 
 class DatabaseError(Exception):
@@ -24,7 +37,11 @@ class DatabaseError(Exception):
 
 
 class Database:
-    def __init__(self, path: str | list[str] = ":memory:"):
+    def __init__(
+        self,
+        path: str | list[str] = ":memory:",
+        read_pool_size: int = READ_POOL_SIZE,
+    ):
         # Multi-address failover seam (reference DbConnect db.go:35 tries
         # each DSN in order): the first address that opens wins.
         self.addresses = [path] if isinstance(path, str) else list(path)
@@ -38,6 +55,16 @@ class Database:
         # issued by that same task join the transaction instead of
         # deadlocking on the non-reentrant lock.
         self._tx_owner: asyncio.Task | None = None
+        # Reader pool (file-backed only): per-connection single threads.
+        self._read_pool_size = max(0, read_pool_size)
+        self._readers: list[
+            tuple[concurrent.futures.ThreadPoolExecutor, sqlite3.Connection]
+        ] = []
+        self._reader_rr = itertools.count()
+        # Observability for tests/metrics: peak concurrent reader calls.
+        self._read_gauge_lock = threading.Lock()
+        self._reads_in_flight = 0
+        self.peak_concurrent_reads = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -72,6 +99,38 @@ class Database:
             )
         if migrate:
             await self.migrate()
+        await self._open_readers()
+
+    async def _open_readers(self) -> None:
+        """Read-only WAL connections, one per reader thread. Memory
+        databases have per-connection state — no pool for them. (Match
+        the exact memory forms, not a substring: a file path merely
+        CONTAINING 'memory' must still get its pool.)"""
+        p = self.path
+        if p == ":memory:" or p.startswith("file::memory:") or (
+            "mode=memory" in p
+        ):
+            return
+
+        def _open_ro():
+            conn = sqlite3.connect(
+                f"file:{self.path}?mode=ro", uri=True,
+                check_same_thread=False,
+            )
+            conn.row_factory = sqlite3.Row
+            return conn
+
+        loop = asyncio.get_running_loop()
+        for i in range(self._read_pool_size):
+            ex = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"nakama-db-r{i}"
+            )
+            try:
+                conn = await loop.run_in_executor(ex, _open_ro)
+            except sqlite3.Error:
+                ex.shutdown(wait=False)
+                break  # reads fall back to the writer path
+            self._readers.append((ex, conn))
 
     async def close(self) -> None:
         # Take the lock so we never close under an open transaction.
@@ -81,6 +140,14 @@ class Database:
                 self._conn = None
                 await self._run(conn.close)
         self._executor.shutdown(wait=False)
+        readers, self._readers = self._readers, []
+        loop = asyncio.get_running_loop()
+        for ex, conn in readers:
+            try:
+                await loop.run_in_executor(ex, conn.close)
+            except Exception:
+                pass
+            ex.shutdown(wait=False)
 
     async def migrate(self) -> list[str]:
         """Apply embedded migrations in order; returns names applied
@@ -173,8 +240,10 @@ class Database:
 
         if asyncio.current_task() is self._tx_owner:
             return await self._with_conn(_fetch)
-        # Lock so reads never observe another task's open transaction on the
-        # shared connection.
+        if self._readers:
+            return await self._run_reader(_fetch)
+        # Single-connection fallback: lock so reads never observe another
+        # task's open transaction on the shared connection.
         async with self._lock:
             return await self._with_conn(_fetch)
 
@@ -187,6 +256,8 @@ class Database:
 
         if asyncio.current_task() is self._tx_owner:
             return await self._with_conn(_fetch)
+        if self._readers:
+            return await self._run_reader(_fetch)
         async with self._lock:
             return await self._with_conn(_fetch)
 
@@ -200,6 +271,30 @@ class Database:
     async def _run(self, fn, *args):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._executor, fn, *args)
+
+    async def _run_reader(self, fn):
+        """Run a read on the next pool connection — no writer lock; WAL
+        isolation guarantees a committed snapshot."""
+        ex, conn = self._readers[
+            next(self._reader_rr) % len(self._readers)
+        ]
+
+        def _call():
+            with self._read_gauge_lock:
+                self._reads_in_flight += 1
+                if self._reads_in_flight > self.peak_concurrent_reads:
+                    self.peak_concurrent_reads = self._reads_in_flight
+            try:
+                return fn(conn)
+            finally:
+                with self._read_gauge_lock:
+                    self._reads_in_flight -= 1
+
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(ex, _call)
+        except sqlite3.Error as e:
+            raise DatabaseError(str(e)) from e
 
     async def _with_conn(self, fn):
         if self._conn is None:

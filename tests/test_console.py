@@ -245,3 +245,175 @@ async def test_prometheus_scrape_endpoint():
     finally:
         await console2.close()
         await disabled.stop(0)
+
+
+async def test_console_storage_write_import_and_account_edit():
+    """VERDICT r2 #5 done-criterion: the console drives a storage
+    import + account edit round-trip (reference
+    console_storage_import.go, console_account.go UpdateAccount)."""
+    server = await make_server()
+    console = Console(server)
+    try:
+        await console.login()
+        # Create a user via the server's own auth core.
+        from nakama_tpu.core import authenticate as core_auth
+
+        user_id, _, _ = await core_auth.authenticate_device(
+            server.db, "console-edit-dev-01", "edituser", True
+        )
+
+        # --- account edit + wallet replacement
+        status, _ = await console.call(
+            "POST", f"/v2/console/account/{user_id}",
+            body={"display_name": "Edited Name",
+                  "metadata": {"tier": "gold"},
+                  "wallet": {"coins": 250}},
+        )
+        assert status == 200
+        status, acct = await console.call(
+            "GET", f"/v2/console/account/{user_id}"
+        )
+        assert acct["user"]["display_name"] == "Edited Name"
+        assert acct["wallet"] == {"coins": 250}
+
+        # --- wallet ledger view
+        await server.wallets.update_wallets(
+            [{"user_id": user_id, "changeset": {"coins": 10},
+              "metadata": {"why": "t"}}]
+        )
+        status, w = await console.call(
+            "GET", f"/v2/console/account/{user_id}/wallet"
+        )
+        assert status == 200
+        assert w["wallet"]["coins"] == 260
+        assert len(w["ledger"]) == 1
+
+        # --- single storage write + read-back + delete
+        status, ack = await console.call(
+            "POST", "/v2/console/storage",
+            body={"collection": "cfg", "key": "motd",
+                  "user_id": "", "value": {"text": "hi"}},
+        )
+        assert status == 200 and ack["version"]
+        # System-owned ("" user_id) objects aren't path-addressable —
+        # browse via the list endpoint.
+        status, listing = await console.call(
+            "GET", "/v2/console/storage?collection=cfg"
+        )
+        assert any(o["key"] == "motd" for o in listing["objects"])
+
+        # --- JSON import lands atomically
+        import_rows = [
+            {"collection": "imp", "key": f"k{i}", "user_id": user_id,
+             "value": {"i": i}}
+            for i in range(5)
+        ]
+        import aiohttp as _aiohttp
+
+        async with console.http.post(
+            console.base + "/v2/console/storage/import",
+            data=json.dumps(import_rows),
+            headers={"Authorization": f"Bearer {console.token}"},
+        ) as resp:
+            assert resp.status == 200
+            assert (await resp.json())["imported"] == 5
+
+        # --- CSV import
+        csv_text = (
+            "collection,key,user_id,value\n"
+            f"impcsv,a,{user_id},\"{{\"\"x\"\": 1}}\"\n"
+            f"impcsv,b,{user_id},\"{{\"\"x\"\": 2}}\"\n"
+        )
+        async with console.http.post(
+            console.base + "/v2/console/storage/import",
+            data=csv_text,
+            headers={
+                "Authorization": f"Bearer {console.token}",
+                "Content-Type": "text/csv",
+            },
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            assert (await resp.json())["imported"] == 2
+
+        status, listing = await console.call(
+            "GET", "/v2/console/storage?collection=impcsv"
+        )
+        assert len(listing["objects"]) == 2
+
+        # --- storage delete
+        status, _ = await console.call(
+            "DELETE", f"/v2/console/storage/imp/k0/{user_id}"
+        )
+        assert status == 200
+        status, listing = await console.call(
+            "GET", f"/v2/console/storage?collection=imp"
+        )
+        assert len(listing["objects"]) == 4
+    finally:
+        await console.close()
+        await server.stop(0)
+
+
+async def test_console_groups_users_and_ui():
+    server = await make_server()
+    console = Console(server)
+    try:
+        await console.login()
+        # Group browse reflects core-created groups.
+        from nakama_tpu.core import authenticate as core_auth
+
+        uid, _, _ = await core_auth.authenticate_device(
+            server.db, "console-group-dev", "groupuser", True
+        )
+        await server.groups.create(uid, "Console Guild")
+        status, groups = await console.call(
+            "GET", "/v2/console/group"
+        )
+        assert status == 200
+        assert any(g["name"] == "Console Guild" for g in groups["groups"])
+        gid = groups["groups"][0]["id"]
+        status, members = await console.call(
+            "GET", f"/v2/console/group/{gid}/member"
+        )
+        assert status == 200 and len(members["group_users"]) == 1
+
+        # Console-user management: admin creates, new user logs in with
+        # its role enforced (maintainer can write, readonly cannot).
+        status, _ = await console.call(
+            "POST", "/v2/console/user",
+            body={"username": "ops1", "password": "longenough",
+                  "role": 4},
+        )
+        assert status == 200
+        ops = Console(server)
+        try:
+            status, _ = await ops.login("ops1", "longenough")
+            assert status == 200
+            status, _ = await ops.call(
+                "POST", "/v2/console/storage",
+                body={"collection": "x", "key": "y", "user_id": "",
+                      "value": {}},
+            )
+            assert status == 403  # readonly blocked from writes
+            status, _ = await ops.call(
+                "POST", "/v2/console/user",
+                body={"username": "ops2", "password": "longenough"},
+            )
+            assert status == 403  # non-admin cannot manage users
+        finally:
+            await ops.close()
+        status, users = await console.call("GET", "/v2/console/user")
+        assert [u["username"] for u in users["users"]] == ["ops1"]
+        status, _ = await console.call(
+            "DELETE", "/v2/console/user/ops1"
+        )
+        assert status == 200
+
+        # Embedded UI serves at /.
+        async with console.http.get(console.base + "/") as resp:
+            assert resp.status == 200
+            text = await resp.text()
+            assert "nakama-tpu console" in text
+    finally:
+        await console.close()
+        await server.stop(0)

@@ -959,3 +959,59 @@ async def test_e2e_ws_protobuf_over_production_route():
     finally:
         await api.close()
         await server.stop(0)
+
+
+async def test_http_storage_concurrency_does_not_serialize(tmp_path):
+    """VERDICT r2 #7 done-criterion: 100 parallel HTTP requests mixing
+    storage reads/writes against a file-backed (WAL read-pool) database
+    complete correctly with reads genuinely overlapping."""
+    config = Config()
+    config.socket.port = 0
+    config.database.address = [str(tmp_path / "http-pool.db")]
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    api = Api(server)
+    try:
+        _, session = await api.call(
+            "POST", "/v2/account/authenticate/device",
+            headers=basic(), body={"account": {"id": "pool-device-0001"}},
+        )
+        auth = bearer(session["token"])
+        await api.call(
+            "PUT", "/v2/storage", headers=auth,
+            body={"objects": [
+                {"collection": "c", "key": f"k{i}", "value": {"i": i}}
+                for i in range(10)
+            ]},
+        )
+
+        async def read(i):
+            status, out = await api.call(
+                "POST", "/v2/storage", headers=auth,
+                body={"object_ids": [
+                    {"collection": "c", "key": f"k{i % 10}"}
+                ]},
+            )
+            assert status == 200, out
+            return len(out["objects"])
+
+        async def write(i):
+            status, out = await api.call(
+                "PUT", "/v2/storage", headers=auth,
+                body={"objects": [
+                    {"collection": "w", "key": f"wk{i}", "value": {}}
+                ]},
+            )
+            assert status == 200, out
+
+        jobs = [read(i) for i in range(60)] + [write(i) for i in range(40)]
+        results = await asyncio.gather(*jobs)
+        assert all(r == 1 for r in results[:60])
+        assert server.db.peak_concurrent_reads > 1
+        status, listing = await api.call(
+            "GET", "/v2/storage/w", headers=auth
+        )
+        assert status == 200 and len(listing["objects"]) == 40
+    finally:
+        await api.close()
+        await server.stop(0)
