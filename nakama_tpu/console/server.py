@@ -335,6 +335,11 @@ class ConsoleServer:
         )
         if exists is None:
             return _err(404, "account not found")
+        # Validate EVERYTHING before the first write — a rejected wallet
+        # must not leave a half-applied profile edit.
+        wallet = body.get("wallet")
+        if "wallet" in body and not isinstance(wallet, dict):
+            return _err(400, "wallet must be a JSON object")
         try:
             await core_account.update_account(
                 self.server.db,
@@ -348,16 +353,13 @@ class ConsoleServer:
                 metadata=body.get("metadata"),
             )
             if "wallet" in body:
-                wallet = body["wallet"]
-                if not isinstance(wallet, dict):
-                    return _err(400, "wallet must be a JSON object")
                 await self.server.db.execute(
                     "UPDATE users SET wallet = ? WHERE id = ?",
                     (json.dumps(wallet), user_id),
                 )
-        except core_auth.AuthError as e:
-            return _err(404, str(e))
         except Exception as e:
+            # Existence was pre-checked: anything raised here is bad
+            # input (e.g. invalid username), not not-found.
             return _err(400, str(e))
         return web.json_response({})
 
@@ -547,22 +549,34 @@ class ConsoleServer:
         except Exception as e:
             return _err(400, f"unparseable import: {e}")
         ops = []
-        for rec in rows:
-            value = rec.get("value", "")
-            if not isinstance(value, str):
-                value = json.dumps(value)
-            ops.append(
-                StorageOpWrite(
-                    collection=rec.get("collection", ""),
-                    key=rec.get("key", ""),
-                    user_id=rec.get("user_id", "") or "",
-                    value=value,
-                    permission_read=int(rec.get("permission_read", 1) or 1),
-                    permission_write=int(
-                        rec.get("permission_write", 1) or 1
-                    ),
+        try:
+            for rec in rows:
+                if not isinstance(rec, dict):
+                    return _err(400, "import rows must be objects")
+                value = rec.get("value", "")
+                if not isinstance(value, str):
+                    value = json.dumps(value)
+
+                def perm(key: str) -> int:
+                    # "" (CSV empty cell) and absent mean default 1;
+                    # an explicit 0 must survive (private objects).
+                    raw = rec.get(key)
+                    if raw is None or raw == "":
+                        return 1
+                    return int(raw)
+
+                ops.append(
+                    StorageOpWrite(
+                        collection=rec.get("collection", ""),
+                        key=rec.get("key", ""),
+                        user_id=rec.get("user_id", "") or "",
+                        value=value,
+                        permission_read=perm("permission_read"),
+                        permission_write=perm("permission_write"),
+                    )
                 )
-            )
+        except (TypeError, ValueError) as e:
+            return _err(400, f"bad import row: {e}")
         if not ops:
             return _err(400, "no rows to import")
         try:
@@ -705,7 +719,10 @@ class ConsoleServer:
             return _err(
                 400, "username and password (>= 8 chars) required"
             )
-        new_role = int(body.get("role", ROLE_READONLY))
+        try:
+            new_role = int(body.get("role", ROLE_READONLY))
+        except (TypeError, ValueError):
+            return _err(400, "invalid role")
         if new_role not in (
             ROLE_ADMIN, ROLE_DEVELOPER, ROLE_MAINTAINER, ROLE_READONLY
         ):

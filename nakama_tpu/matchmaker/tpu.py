@@ -785,7 +785,12 @@ class TpuBackend:
 
         def _fetch(dev=cand_dev, out=holder):
             try:
-                out["np"] = np.asarray(dev)
+                # Force a real C-contiguous host ndarray HERE, in the
+                # gap: this runtime hands back a strided view, and the
+                # strided 16MB gather it implies was measured at
+                # 10-300ms when paid lazily inside the interval
+                # (ascontiguousarray at collect — the old code).
+                out["np"] = np.ascontiguousarray(np.asarray(dev))
             except Exception as e:  # surfaced at collect
                 out["err"] = e
 
@@ -913,7 +918,9 @@ class TpuBackend:
             thread.join()
             if "err" in holder:
                 raise holder["err"]
-            return np.ascontiguousarray(holder["np"][:n_rows])
+            # The fetch thread materialized a real host ndarray; a row
+            # slice of it stays C-contiguous, so no interval-side copy.
+            return holder["np"][:n_rows]
 
         _, scores, cand = pending
         cand_np = np.asarray(cand)[:n_rows]

@@ -78,7 +78,25 @@ def _load_path(path: str):
         raise ModuleLoadError(f"runtime path not a directory: {path}")
     out = []
     for fname in sorted(os.listdir(path)):
-        if not fname.endswith(".py") or fname.startswith("_"):
+        if fname.startswith("_"):
+            continue
+        if fname.endswith(".lua"):
+            # Guest-language provider (runtime/lua): the chunk registers
+            # its hooks at load via the global `nk`, so its "init" only
+            # needs to construct the module.
+            with open(os.path.join(path, fname)) as fh:
+                source = fh.read()
+
+            def lua_init(
+                ctx, log, nk, initializer, _src=source, _name=fname
+            ):
+                from .lua import load_lua_module
+
+                load_lua_module(_name, _src, log, nk, initializer)
+
+            out.append((fname, lua_init))
+            continue
+        if not fname.endswith(".py"):
             continue
         mod_name = f"nakama_runtime_{fname[:-3]}"
         spec = importlib.util.spec_from_file_location(

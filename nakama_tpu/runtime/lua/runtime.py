@@ -1,0 +1,349 @@
+"""Lua module provider — wires guest Lua code into the hook registry.
+
+Mirrors the reference's Lua provider shape (reference
+server/runtime_lua.go: modules run at startup and register hooks through
+the `nakama` module): a ``*.lua`` file under ``config.runtime.path``
+executes at load with a global ``nk`` table; registrations adapt guest
+functions onto the SAME Initializer the Python provider uses, so the
+pipeline/server sees one hook registry regardless of language.
+
+Threading model: guest invocations run on ONE dedicated worker thread
+per module (the reference sizes a VM pool; one VM is the subset here) —
+async `nk` calls bridge back to the server's event loop with
+run_coroutine_threadsafe and block only the worker. At module LOAD time
+the chunk runs on the caller's thread; async `nk` calls there would
+deadlock the loop and instead raise a clear error (register in the
+chunk, do I/O in handlers — the reference's own guidance).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+import time
+import uuid
+
+from .interp import Interp, LuaError, LuaRuntimeError, LuaTable
+from .stdlib import from_lua, new_globals, to_lua
+
+INVOKE_TIMEOUT_SEC = 30.0
+FUEL_PER_INVOCATION = 2_000_000
+
+
+class LuaModule:
+    """One loaded .lua module: interpreter + worker thread + nk bridge."""
+
+    def __init__(self, name: str, source: str, logger, nk, initializer):
+        self.name = name
+        self.logger = logger.with_fields(lua_module=name)
+        self.nk = nk
+        self.initializer = initializer
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"lua-{name}"
+        )
+        self._lock = threading.Lock()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self.globals = new_globals(
+            print_fn=lambda text: self.logger.info("lua print", text=text)
+        )
+        self.interp = Interp(self.globals)
+        self.globals.set("nk", self._build_nk_table())
+        from .parser import parse
+
+        chunk = parse(source, chunk=name)
+        self.interp.fuel = FUEL_PER_INVOCATION
+        self.interp.run_chunk(chunk)
+
+    # ----------------------------------------------------------- invoking
+
+    def _invoke(self, fn, args: tuple):
+        """Call a guest function with a fresh fuel budget (serialized:
+        one interpreter state)."""
+        with self._lock:
+            self.interp.fuel = FUEL_PER_INVOCATION
+            return self.interp.call(fn, args)
+
+    def _await(self, coro):
+        """Bridge an async nk call from the Lua worker thread."""
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            pass
+        else:
+            # On a loop thread (module load time): blocking here would
+            # deadlock the loop.
+            coro.close()
+            raise LuaRuntimeError(
+                "async nakama calls are only available inside handlers,"
+                " not at module load time"
+            )
+        if self._loop is not None and self._loop.is_running():
+            return asyncio.run_coroutine_threadsafe(
+                coro, self._loop
+            ).result(INVOKE_TIMEOUT_SEC)
+        return asyncio.run(coro)
+
+    def _ctx_table(self, ctx) -> LuaTable:
+        t = LuaTable()
+        for attr in (
+            "user_id", "username", "session_id", "mode", "node",
+        ):
+            value = getattr(ctx, attr, None)
+            if value:
+                t.set(attr, to_lua(value))
+        vars_ = getattr(ctx, "vars", None)
+        if vars_:
+            t.set("vars", to_lua(dict(vars_)))
+        return t
+
+    def _session_ctx(self, session) -> LuaTable:
+        t = LuaTable()
+        t.set("user_id", getattr(session, "user_id", ""))
+        t.set("username", getattr(session, "username", ""))
+        t.set("session_id", getattr(session, "id", ""))
+        return t
+
+    # --------------------------------------------------------- nk bridge
+
+    def _build_nk_table(self) -> LuaTable:
+        nk_t = LuaTable()
+        module = self
+
+        def reg(name, fn):
+            nk_t.set(name, fn)
+
+        # ---- registrations (guest fn first, like the reference Lua API)
+        def _register(kind):
+            def do_register(interp, fn=None, key=None):
+                if fn is None:
+                    raise LuaRuntimeError(f"register_{kind}: function required")
+                module._register_hook(kind, fn, key)
+
+            return do_register
+
+        for kind in (
+            "rpc", "rt_before", "rt_after", "req_before", "req_after",
+            "matchmaker_matched", "tournament_end", "tournament_reset",
+            "leaderboard_reset", "shutdown", "event",
+            "event_session_start", "event_session_end",
+        ):
+            reg(f"register_{kind}", _register(kind))
+
+        # ---- logger
+        for level in ("debug", "info", "warn", "error"):
+            def make_log(level=level):
+                def log(interp, msg=None, *rest):
+                    getattr(module.logger, level)(
+                        str(msg) if msg is not None else ""
+                    )
+
+                return log
+
+            reg(f"logger_{level}", make_log())
+
+        # ---- pure helpers
+        reg("uuid_v4", lambda interp: str(uuid.uuid4()))
+        reg("time", lambda interp: float(time.time() * 1000))
+
+        # ---- sync nk facade calls
+        def _stream_send(interp, stream=None, data=None, reliable=True):
+            module.nk.stream_send(
+                from_lua(stream) or {}, str(data or ""), bool(reliable)
+            )
+
+        reg("stream_send", _stream_send)
+        reg(
+            "stream_count",
+            lambda interp, stream=None: float(
+                module.nk.stream_count(from_lua(stream) or {})
+            ),
+        )
+        reg(
+            "match_create",
+            lambda interp, mod=None, params=None: module.nk.match_create(
+                str(mod or ""), from_lua(params) or {}
+            ),
+        )
+        reg(
+            "match_list",
+            lambda interp, limit=None: to_lua(
+                module.nk.match_list(int(limit or 10))
+            ),
+        )
+
+        # ---- async nk facade calls (bridged to the loop)
+        def async_fn(name, convert_out=True):
+            def call(interp, *args):
+                py_args = [from_lua(a) for a in args]
+                coro = getattr(module.nk, name)(*py_args)
+                out = module._await(coro)
+                return to_lua(out) if convert_out else None
+
+            return call
+
+        for name in (
+            "storage_read", "storage_write", "storage_delete",
+            "account_get_id", "users_get_id", "users_get_username",
+            "wallet_update", "notification_send",
+            "leaderboard_record_write", "leaderboard_records_list",
+        ):
+            reg(name, async_fn(name))
+
+        return nk_t
+
+    # ------------------------------------------------------ hook adapters
+
+    def _register_hook(self, kind: str, fn, key):
+        init = self.initializer
+        key_str = str(key).lower() if key is not None else None
+
+        if kind == "rpc":
+            if not key_str:
+                raise LuaRuntimeError("register_rpc: id required")
+
+            async def rpc_wrapper(ctx, payload, _fn=fn):
+                loop = asyncio.get_running_loop()
+                self._loop = loop
+                out = await loop.run_in_executor(
+                    self._pool,
+                    self._invoke,
+                    _fn,
+                    (self._ctx_table(ctx), payload),
+                )
+                result = out[0] if out else None
+                if result is None:
+                    return ""
+                if not isinstance(result, str):
+                    raise LuaError(
+                        "lua rpc must return a string (use json.encode)"
+                    )
+                return result
+
+            init.register_rpc(key_str, rpc_wrapper)
+        elif kind in ("rt_before", "rt_after"):
+            if not key_str:
+                raise LuaRuntimeError(f"register_{kind}: message required")
+
+            if kind == "rt_before":
+
+                async def before_wrapper(session, key2, body, _fn=fn):
+                    loop = asyncio.get_running_loop()
+                    self._loop = loop
+                    out = await loop.run_in_executor(
+                        self._pool,
+                        self._invoke,
+                        _fn,
+                        (self._session_ctx(session), to_lua(body)),
+                    )
+                    result = out[0] if out else None
+                    if result is None:
+                        return None  # rejection, like the reference
+                    return from_lua(result)
+
+                init.register_before_rt(key_str, before_wrapper)
+            else:
+
+                async def after_wrapper(session, key2, body, _fn=fn):
+                    loop = asyncio.get_running_loop()
+                    self._loop = loop
+                    await loop.run_in_executor(
+                        self._pool,
+                        self._invoke,
+                        _fn,
+                        (self._session_ctx(session), to_lua(body)),
+                    )
+
+                init.register_after_rt(key_str, after_wrapper)
+        elif kind in ("req_before", "req_after"):
+            if not key_str:
+                raise LuaRuntimeError(f"register_{kind}: method required")
+
+            if kind == "req_before":
+
+                async def req_before(ctx, body, _fn=fn):
+                    loop = asyncio.get_running_loop()
+                    self._loop = loop
+                    out = await loop.run_in_executor(
+                        self._pool,
+                        self._invoke,
+                        _fn,
+                        (self._ctx_table(ctx), to_lua(body)),
+                    )
+                    result = out[0] if out else None
+                    return None if result is None else from_lua(result)
+
+                init.register_before_req(key_str, req_before)
+            else:
+
+                async def req_after(ctx, body, result, _fn=fn):
+                    loop = asyncio.get_running_loop()
+                    self._loop = loop
+                    await loop.run_in_executor(
+                        self._pool,
+                        self._invoke,
+                        _fn,
+                        (
+                            self._ctx_table(ctx),
+                            to_lua(body),
+                            to_lua(result),
+                        ),
+                    )
+
+                init.register_after_req(key_str, req_after)
+        elif kind == "matchmaker_matched":
+
+            def matched_wrapper(entries, _fn=fn):
+                # Called synchronously from the matchmaker tail — run
+                # inline (never on the loop thread).
+                lua_entries = to_lua(
+                    [
+                        {
+                            "presence": e.presence.as_dict(),
+                            "party_id": e.party_id,
+                            "string_properties": e.string_properties,
+                            "numeric_properties": e.numeric_properties,
+                        }
+                        for e in entries
+                    ]
+                )
+                out = self._invoke(_fn, (lua_entries,))
+                result = out[0] if out else None
+                return str(result) if result else ""
+
+            init.register_matchmaker_matched(matched_wrapper)
+        elif kind in (
+            "tournament_end", "tournament_reset", "leaderboard_reset",
+            "event", "event_session_start", "event_session_end",
+            "shutdown",
+        ):
+
+            def generic_wrapper(*args, _fn=fn):
+                lua_args = tuple(
+                    to_lua(a) if isinstance(a, (dict, list, str, int, float,
+                                                bool, type(None)))
+                    else self._ctx_table(a)
+                    for a in args
+                )
+                return self._invoke(_fn, lua_args)
+
+            getattr(init, {
+                "tournament_end": "register_tournament_end",
+                "tournament_reset": "register_tournament_reset",
+                "leaderboard_reset": "register_leaderboard_reset",
+                "event": "register_event",
+                "event_session_start": "register_event_session_start",
+                "event_session_end": "register_event_session_end",
+                "shutdown": "register_shutdown",
+            }[kind])(generic_wrapper)
+        else:  # pragma: no cover
+            raise LuaRuntimeError(f"unknown registration {kind}")
+
+
+def load_lua_module(name, source, logger, nk, initializer) -> LuaModule:
+    try:
+        return LuaModule(name, source, logger, nk, initializer)
+    except LuaError as e:
+        from ..loader import ModuleLoadError
+
+        raise ModuleLoadError(f"lua module {name}: {e}") from e

@@ -1,0 +1,302 @@
+"""Sandboxed Lua runtime (VERDICT r2 #10): language subset semantics,
+sandbox guarantees (fuel, depth, no ambient capabilities), and the
+end-to-end story — a .lua module registering rpc + before-hook +
+matchmaker_matched against a live server, exercised over HTTP/WS.
+
+Reference counterpart: server/runtime_lua_nakama.go + internal/
+gopher-lua (the embedded VM); this is an original subset interpreter,
+wired into the SAME hook registry as the Python provider.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+import websockets
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.runtime.lua.interp import (
+    FuelExhausted,
+    LuaRuntimeError,
+)
+from nakama_tpu.runtime.lua.parser import parse
+from nakama_tpu.runtime.lua.stdlib import from_lua, new_interp
+from nakama_tpu.server import NakamaServer
+
+
+def run(src: str, fuel: int | None = None):
+    out = []
+    interp = new_interp(print_fn=out.append, fuel=fuel)
+    result = interp.run_chunk(parse(src, "test"))
+    return out, result
+
+
+# ------------------------------------------------------------- language
+
+
+def test_lua_core_semantics():
+    out, _ = run(
+        """
+        local function fib(n)
+          if n < 2 then return n end
+          return fib(n - 1) + fib(n - 2)
+        end
+        print(fib(15))
+
+        local acc = {}
+        for i = 10, 1, -2 do table.insert(acc, i) end
+        print(table.concat(acc, " "))
+
+        local t = {x = 1}
+        function t.get(self) return self.x end
+        function t:bump() self.x = self.x + 1 end
+        t:bump()
+        print(t:get())
+
+        local a, b, c = (function() return 1, 2, 3 end)()
+        print(a + b + c)
+        """
+    )
+    assert out == ["610", "10 8 6 4 2", "2", "6"]
+
+
+def test_lua_strings_tables_json():
+    out, _ = run(
+        """
+        print(("Xyz"):lower(), string.rep("ab", 3))
+        print(string.format("%05d|%.2f|%s", 42, 3.14159, "ok"))
+        print(string.match("user-123", "%a+%-(%d+)"))
+        local words = {}
+        for w in string.gmatch("alpha beta gamma", "%a+") do
+          table.insert(words, w)
+        end
+        print(#words, words[2])
+        local doc = json.decode('{"nums": [1,2,3], "flag": false}')
+        doc.nums[4] = 4
+        print(json.encode(doc.nums), tostring(doc.flag))
+        """
+    )
+    assert out == [
+        "xyz\tababab",
+        "00042|3.14|ok",
+        "123",
+        "3\tbeta",
+        "[1, 2, 3, 4]\tfalse",
+    ]
+
+
+def test_lua_closures_and_scoping():
+    out, _ = run(
+        """
+        local function make(step)
+          local n = 0
+          return function() n = n + step return n end
+        end
+        local by2, by10 = make(2), make(10)
+        by2() by10()
+        print(by2(), by10())
+
+        local x = "outer"
+        do local x = "inner" print(x) end
+        print(x)
+        """
+    )
+    assert out == ["4\t20", "inner", "outer"]
+
+
+def test_lua_pcall_error_values():
+    out, _ = run(
+        """
+        local ok, err = pcall(function() error({code = 42}) end)
+        print(ok, type(err), err.code)
+        local ok2, err2 = pcall(function() return nil + 1 end)
+        print(ok2, string.find(err2, "arithmetic") ~= nil)
+        print(pcall(function() return "fine" end))
+        """
+    )
+    assert out == ["false\ttable\t42", "false\ttrue", "true\tfine"]
+
+
+# -------------------------------------------------------------- sandbox
+
+
+def test_lua_fuel_budget_uncatchable():
+    with pytest.raises(FuelExhausted):
+        run("pcall(function() while true do end end)", fuel=50_000)
+
+
+def test_lua_no_ambient_capabilities():
+    _, result = run(
+        "return io, os, require, load, loadstring, dofile, debug"
+    )
+    assert all(v is None for v in result)
+
+
+def test_lua_depth_cap():
+    with pytest.raises(LuaRuntimeError, match="depth"):
+        run("local function f() return f() + 1 end f()")
+
+
+def test_lua_host_values_cross_by_conversion():
+    out, result = run("return {list = {1, 2}, n = 3.0, s = 'x'}")
+    value = from_lua(result[0])
+    assert value == {"list": [1, 2], "n": 3, "s": "x"}
+
+
+# ------------------------------------------------------- server e2e
+
+
+LUA_MODULE = """
+-- Operator extension module (guest language), registering across the
+-- same hook registry the Python provider uses.
+nk.logger_info("lua module loading")
+
+nk.register_rpc(function(ctx, payload)
+  local data = json.decode(payload)
+  return json.encode({
+    doubled = data.value * 2,
+    caller = ctx.user_id,
+  })
+end, "lua_double")
+
+nk.register_rpc(function(ctx, payload)
+  local objects = nk.storage_write({
+    {collection = "lua", key = "k1", user_id = ctx.user_id,
+     value = json.encode({written = true}), permission_read = 2}
+  })
+  local back = nk.storage_read({
+    {collection = "lua", key = "k1", user_id = ctx.user_id}
+  })
+  return back[1].value
+end, "lua_storage")
+
+nk.register_rt_before(function(ctx, envelope)
+  -- Reject queries for a banned mode; rewrite others.
+  local q = envelope.query or ""
+  if string.find(q, "banned", 1, true) then
+    return nil
+  end
+  envelope.min_count = 2
+  envelope.max_count = 2
+  return envelope
+end, "matchmaker_add")
+"""
+
+
+async def make_server(tmp_path):
+    mod_dir = tmp_path / "modules"
+    mod_dir.mkdir()
+    (mod_dir / "ext.lua").write_text(LUA_MODULE)
+    config = Config()
+    config.socket.port = 0
+    config.runtime.path = str(mod_dir)
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    return server
+
+
+async def test_lua_module_rpc_and_hooks_end_to_end(tmp_path):
+    server = await make_server(tmp_path)
+    http = aiohttp.ClientSession()
+    try:
+        assert "ext.lua" in server.runtime.modules
+        base = f"http://127.0.0.1:{server.port}"
+        import base64
+
+        basic = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"defaultkey:").decode()
+        }
+        async with http.post(
+            f"{base}/v2/account/authenticate/device",
+            headers=basic,
+            json={"account": {"id": "lua-device-000001"}},
+        ) as r:
+            session = await r.json()
+        bearer = {"Authorization": f"Bearer {session['token']}"}
+
+        # Lua rpc over HTTP: real payload round-trip through the guest.
+        async with http.post(
+            f"{base}/v2/rpc/lua_double",
+            headers=bearer,
+            data=json.dumps(json.dumps({"value": 21})),
+        ) as r:
+            assert r.status == 200, await r.text()
+            out = json.loads((await r.json())["payload"])
+        assert out["doubled"] == 42
+        assert out["caller"]  # ctx carried the caller id
+
+        # Lua rpc calling async nk.storage_write/read from the guest.
+        async with http.post(
+            f"{base}/v2/rpc/lua_storage", headers=bearer,
+            data=json.dumps(""),
+        ) as r:
+            assert r.status == 200, await r.text()
+            stored = json.loads((await r.json())["payload"])
+        assert stored == {"written": True}
+
+        # Lua before-hook gates and rewrites matchmaker_add over WS.
+        ws = await websockets.connect(
+            f"ws://127.0.0.1:{server.port}/ws?token={session['token']}"
+        )
+
+        async def recv_until(key):
+            for _ in range(10):
+                env = json.loads(await asyncio.wait_for(ws.recv(), 5))
+                if key in env:
+                    return env
+            raise AssertionError(f"no {key}")
+
+        await ws.send(
+            json.dumps(
+                {
+                    "cid": "1",
+                    "matchmaker_add": {
+                        "query": "+properties.mode:banned", "min_count": 4,
+                        "max_count": 4,
+                    },
+                }
+            )
+        )
+        # Rejection is SILENT (reference: nil from a before-hook drops
+        # the message) — prove nothing was enqueued via a ping fence.
+        await ws.send(json.dumps({"cid": "p", "ping": {}}))
+        fence = await recv_until("pong")
+        assert fence["cid"] == "p"
+        assert len(server.matchmaker) == 0
+
+        await ws.send(
+            json.dumps(
+                {
+                    "cid": "2",
+                    "matchmaker_add": {
+                        "query": "*", "min_count": 8, "max_count": 8,
+                    },
+                }
+            )
+        )
+        ticket = await recv_until("matchmaker_ticket")
+        assert ticket["matchmaker_ticket"]["ticket"]
+        # The hook rewrote the counts to 2/2.
+        t = next(iter(server.matchmaker.tickets.values()))
+        assert (t.min_count, t.max_count) == (2, 2)
+        await ws.close()
+    finally:
+        await http.close()
+        await server.stop(0)
+
+
+async def test_lua_module_load_errors_are_fatal(tmp_path):
+    mod_dir = tmp_path / "modules"
+    mod_dir.mkdir()
+    (mod_dir / "broken.lua").write_text("this is not lua ===")
+    config = Config()
+    config.socket.port = 0
+    config.runtime.path = str(mod_dir)
+    server = NakamaServer(config, quiet_logger())
+    with pytest.raises(Exception, match="broken.lua"):
+        await server.start()
+    await server.stop(0)
