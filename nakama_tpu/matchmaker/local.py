@@ -291,6 +291,27 @@ class LocalMatchmaker:
         async def _loop():
             import gc
 
+            # The gap pass below owns full collections; an AUTOMATIC
+            # gen2 pass over this server's steady heap (~100k ticket
+            # objects plus runtime state) measures 100-650ms and lands
+            # mid-interval whenever allocation counters happen to cross
+            # the default threshold there. Push the gen2 trigger out of
+            # reach — every gap still runs an explicit full collect, so
+            # cyclic garbage is bounded by one interval's churn.
+            g0, g1, g2_saved = gc.get_threshold()
+            gc.set_threshold(g0, g1, 1_000_000)
+            try:
+                await _loop_body()
+            finally:
+                # Process-global state: hand automatic gen2 collection
+                # back when this matchmaker stops — without the gap
+                # collector running, the rest of the process must not be
+                # left with full collections effectively disabled.
+                gc.set_threshold(g0, g1, g2_saved)
+
+        async def _loop_body():
+            import gc
+
             while not self._stopped:
                 # Split the configured interval (cadence stays exactly
                 # interval_sec): a short head-gap after process() lets a

@@ -376,8 +376,18 @@ class Interp:
                 self.depth -= 1
         if callable(fn):
             # Host function: receives (interp, *args), returns tuple/
-            # value/None.
-            out = fn(self, *args)
+            # value/None. ANY host-level exception (bad guest argument
+            # hitting int()/float()/ord()/...) must surface as a guest
+            # error catchable by pcall — never abort the chunk with a
+            # raw Python traceback (sandbox containment).
+            try:
+                out = fn(self, *args)
+            except (LuaError, BreakSignal, ReturnSignal):
+                raise
+            except Exception as e:
+                raise LuaRuntimeError(
+                    f"{type(e).__name__}: {e}"
+                ) from e
             if out is None:
                 return ()
             if isinstance(out, tuple):

@@ -123,6 +123,8 @@ def tokenize(src: str, chunk: str = "?") -> list[Token]:
                 j = i + 2
                 while j < n and (src[j] in "0123456789abcdefABCDEF"):
                     j += 1
+                if j == i + 2:  # bare "0x"
+                    err("malformed number near '0x'")
                 value = float(int(src[i:j], 16))
             else:
                 while j < n and (src[j].isdigit() or src[j] == "."):

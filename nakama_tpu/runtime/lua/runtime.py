@@ -43,6 +43,7 @@ class LuaModule:
             max_workers=1, thread_name_prefix=f"lua-{name}"
         )
         self._lock = threading.Lock()
+        self._no_async = threading.local()
         self._loop: asyncio.AbstractEventLoop | None = None
         self.globals = new_globals(
             print_fn=lambda text: self.logger.info("lua print", text=text)
@@ -57,15 +58,39 @@ class LuaModule:
 
     # ----------------------------------------------------------- invoking
 
-    def _invoke(self, fn, args: tuple):
+    def _invoke(self, fn, args: tuple, no_async: bool = False):
         """Call a guest function with a fresh fuel budget (serialized:
-        one interpreter state)."""
-        with self._lock:
+        one interpreter state). `no_async`: this invocation runs on (or
+        blocks) the event-loop thread, so the async nk bridge must fail
+        fast with a truthful error instead of deadlocking toward its
+        timeout. The lock acquire is bounded for the same reason."""
+        if not self._lock.acquire(timeout=INVOKE_TIMEOUT_SEC):
+            raise LuaRuntimeError(
+                f"lua module {self.name} busy for >"
+                f"{INVOKE_TIMEOUT_SEC:.0f}s (a guest hook is likely"
+                " blocked on an async nakama call from a sync context)"
+            )
+        try:
+            self._no_async.flag = no_async
             self.interp.fuel = FUEL_PER_INVOCATION
             return self.interp.call(fn, args)
+        finally:
+            self._no_async.flag = False
+            self._lock.release()
 
     def _await(self, coro):
         """Bridge an async nk call from the Lua worker thread."""
+        if getattr(self._no_async, "flag", False):
+            # Synchronous hook contexts (matchmaker_matched, scheduler
+            # callbacks) run guest code while the event loop waits on
+            # the result; bridging back to the loop here would deadlock
+            # toward the timeout. Fail fast and truthfully.
+            coro.close()
+            raise LuaRuntimeError(
+                "async nakama calls are not available in synchronous"
+                " hooks (matchmaker_matched/scheduler); use an rpc or"
+                " rt hook"
+            )
         try:
             asyncio.get_running_loop()
         except RuntimeError:
@@ -294,8 +319,12 @@ class LuaModule:
         elif kind == "matchmaker_matched":
 
             def matched_wrapper(entries, _fn=fn):
-                # Called synchronously from the matchmaker tail — run
-                # inline (never on the loop thread).
+                # Called synchronously from the matchmaker tail, which
+                # may be the event-loop thread: run inline with the
+                # no-async flag (the bridge fails fast instead of
+                # deadlocking) and a bounded lock acquire. Guest time
+                # here blocks the interval — bounded by the fuel budget,
+                # and matched hooks are return-an-id lookups by design.
                 lua_entries = to_lua(
                     [
                         {
@@ -307,7 +336,7 @@ class LuaModule:
                         for e in entries
                     ]
                 )
-                out = self._invoke(_fn, (lua_entries,))
+                out = self._invoke(_fn, (lua_entries,), no_async=True)
                 result = out[0] if out else None
                 return str(result) if result else ""
 
@@ -325,7 +354,9 @@ class LuaModule:
                     else self._ctx_table(a)
                     for a in args
                 )
-                return self._invoke(_fn, lua_args)
+                # Scheduler/event callers may be sync on the loop
+                # thread — same no-async posture as matched_wrapper.
+                return self._invoke(_fn, lua_args, no_async=True)
 
             getattr(init, {
                 "tournament_end": "register_tournament_end",
@@ -341,9 +372,11 @@ class LuaModule:
 
 
 def load_lua_module(name, source, logger, nk, initializer) -> LuaModule:
+    from .lexer import LuaSyntaxError
+
     try:
         return LuaModule(name, source, logger, nk, initializer)
-    except LuaError as e:
+    except (LuaError, LuaSyntaxError) as e:
         from ..loader import ModuleLoadError
 
         raise ModuleLoadError(f"lua module {name}: {e}") from e

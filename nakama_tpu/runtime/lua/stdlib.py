@@ -86,11 +86,61 @@ _CLASS = {
 }
 
 
+# Class -> set-body expansion for use INSIDE [...] (no surrounding
+# brackets; negated classes cannot be embedded in a positive set).
+_CLASS_BODY = {
+    "a": "a-zA-Z", "d": "0-9", "l": "a-z", "u": "A-Z",
+    "s": " \\t\\n\\r\\f\\v", "w": "a-zA-Z0-9",
+    "p": "\\!-/\\:-@\\[-`\\{-~",
+}
+
+
 def _lua_pattern_to_re(pat: str) -> str:
     out = []
     i, n = 0, len(pat)
     while i < n:
         c = pat[i]
+        if c == "[":
+            # Bracket set: '-' is a RANGE here (not the lazy quantifier)
+            # and %classes expand to bare set bodies.
+            j = i + 1
+            body = []
+            if j < n and pat[j] == "^":
+                body.append("^")
+                j += 1
+            first = True
+            while j < n and (pat[j] != "]" or first):
+                first = False
+                ch = pat[j]
+                if ch == "%":
+                    if j + 1 >= n:
+                        raise LuaRuntimeError(
+                            "malformed pattern (ends with %)"
+                        )
+                    nxt = pat[j + 1]
+                    if nxt in _CLASS_BODY:
+                        body.append(_CLASS_BODY[nxt])
+                    elif nxt.upper() in _CLASS_BODY and nxt.isupper():
+                        raise LuaRuntimeError(
+                            f"negated class %{nxt} inside a set is not"
+                            " supported"
+                        )
+                    else:
+                        body.append(re.escape(nxt))
+                    j += 2
+                    continue
+                if ch == "-":
+                    body.append("-")
+                elif ch in "\\^]":
+                    body.append("\\" + ch)
+                else:
+                    body.append(ch)
+                j += 1
+            if j >= n:
+                raise LuaRuntimeError("malformed pattern (missing ']')")
+            out.append("[" + "".join(body) + "]")
+            i = j + 1
+            continue
         if c == "%":
             if i + 1 >= n:
                 raise LuaRuntimeError("malformed pattern (ends with %)")
@@ -106,9 +156,9 @@ def _lua_pattern_to_re(pat: str) -> str:
             out.append("*?")
             i += 1
             continue
-        if c in "().[]^$*+?":
+        if c in "().^$*+?":
             # These align with regex enough for the supported subset:
-            # anchors, char sets, captures, greedy quantifiers.
+            # anchors, captures, greedy quantifiers.
             out.append(c)
             i += 1
             continue
@@ -223,6 +273,8 @@ def install(g: LuaTable, print_fn=None):
             raise LuaRuntimeError("bad argument to 'unpack'")
         lo = int(i or 1)
         hi = int(j if j is not None else t.length())
+        if hi - lo >= 1_000_000:
+            raise LuaRuntimeError("unpack range too large")
         return tuple(t.get(float(k)) for k in range(lo, hi + 1))
 
     reg("unpack", _unpack)
@@ -273,7 +325,15 @@ def install(g: LuaTable, print_fn=None):
     strlib.set("len", lambda interp, s="": float(len(s)))
     strlib.set("upper", lambda interp, s="": s.upper())
     strlib.set("lower", lambda interp, s="": s.lower())
-    strlib.set("rep", lambda interp, s="", n=0: s * int(n))
+    def _rep(interp, s="", n=0):
+        count = int(lua_tonumber(n) or 0)
+        if len(s) * max(count, 0) > 8_000_000:
+            # Fuel can't see inside one host call: cap allocation so a
+            # single rep can't take the process's memory.
+            raise LuaRuntimeError("string.rep result too large")
+        return s * count
+
+    strlib.set("rep", _rep)
     strlib.set(
         "byte",
         lambda interp, s="", i=None: (
@@ -375,6 +435,10 @@ def install(g: LuaTable, print_fn=None):
         s = s or ""
         count = [0]
         limit = int(n) if n is not None else -1
+        if limit == 0:
+            # Lua: n=0 replaces nothing; Python re.sub's count=0 means
+            # unlimited — divergent semantics, handle explicitly.
+            return (s, 0.0)
 
         def do_repl(m: re.Match) -> str:
             count[0] += 1
@@ -451,6 +515,8 @@ def install(g: LuaTable, print_fn=None):
             raise LuaRuntimeError("bad argument to 'concat'")
         lo = int(i or 1)
         hi = int(j if j is not None else t.length())
+        if hi - lo >= 1_000_000:
+            raise LuaRuntimeError("concat range too large")
         return (sep or "").join(
             lua_tostring(t.get(float(k))) for k in range(lo, hi + 1)
         )

@@ -246,3 +246,23 @@ async def test_grpc_subscription_validate_and_get():
     finally:
         await c.close()
         await server.stop()
+
+
+def test_every_service_method_has_a_gateway_route():
+    """Drift guard: a rpc added to proto/api.proto without a ROUTES row
+    would fail at runtime with UNIMPLEMENTED; catch it at test time."""
+    from nakama_tpu.api.grpc_server import ROUTES
+
+    methods = {
+        m.name
+        for m in P.DESCRIPTOR.services_by_name["NakamaApi"].methods
+    }
+    missing = methods - set(ROUTES)
+    extra = set(ROUTES) - methods
+    assert not missing, f"rpcs without gateway routes: {sorted(missing)}"
+    assert not extra, f"gateway routes without rpcs: {sorted(extra)}"
+    # And every route's request/response types match the descriptor.
+    for m in P.DESCRIPTOR.services_by_name["NakamaApi"].methods:
+        spec = ROUTES[m.name]
+        assert spec.request.DESCRIPTOR is m.input_type, m.name
+        assert spec.response.DESCRIPTOR is m.output_type, m.name

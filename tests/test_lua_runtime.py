@@ -300,3 +300,32 @@ async def test_lua_module_load_errors_are_fatal(tmp_path):
     with pytest.raises(Exception, match="broken.lua"):
         await server.start()
     await server.stop(0)
+
+
+def test_lua_bracket_classes_and_gsub_limit():
+    """Regression (r3 review): bracket sets must keep '-' as a range and
+    expand %classes bare; gsub n=0 replaces nothing; host exceptions from
+    bad guest args are pcall-catchable; allocation shims are capped."""
+    out, _ = run(
+        """
+        print(string.match("foo42", "[a-z]+"))
+        print(string.match("x7", "[%d]"))
+        print(string.gsub("aaa", "a", "b", 0))
+        print(string.gsub("aaa", "a", "b", 2))
+        local ok, err = pcall(tonumber, "zz", 16)
+        print(ok)
+        local ok2 = pcall(string.rep, "a", 200000000)
+        print(ok2)
+        local ok3 = pcall(function() return unpack({}, 1, 1e9) end)
+        print(ok3)
+        """
+    )
+    assert out == ["foo", "7", "aaa\t0", "bba\t2", "false", "false",
+                   "false"]
+
+
+def test_lua_malformed_number_is_syntax_error():
+    from nakama_tpu.runtime.lua.lexer import LuaSyntaxError
+
+    with pytest.raises(LuaSyntaxError):
+        parse("return 0x", "bad")
