@@ -81,9 +81,9 @@ def _pow2_blocks(blocks: int) -> int:
 def _work_ready(work: tuple) -> bool:
     """Has this dispatched work's device compute + D2H completed?"""
     pending = work[0]
-    if pending[0] == "big":
-        return not pending[3].is_alive()
-    return True  # small-path transfers are collected synchronously
+    if pending[0] in ("big", "small_bg"):
+        return not pending[-1].is_alive()
+    return True  # oracle-path results are already host-side
 
 
 class TpuBackend:
@@ -535,6 +535,18 @@ class TpuBackend:
             ):
                 ready_works.append(self._pipeline_queue.popleft())
                 collectable -= 1
+            if (
+                collectable > 0
+                and not ready_works
+                and not len(device_slots)
+            ):
+                # Every remaining active is in-flight and nothing came
+                # back yet: this interval has NOTHING else to do, so
+                # block-drain the head (collection joins its fetch).
+                # Without this, back-to-back process() calls (tests, a
+                # zero-gap cadence) can starve the fetch thread forever
+                # while its slots stay in-flight — livelock.
+                ready_works.append(self._pipeline_queue.popleft())
 
         sel = self._sel_mask
         sel[:] = False
@@ -684,8 +696,8 @@ class TpuBackend:
         gap, and at shutdown so no fetch thread outlives the runtime."""
         for work in list(self._pipeline_queue):
             pending = work[0]
-            if pending[0] == "big":
-                pending[3].join(timeout)
+            if pending[0] in ("big", "small_bg"):
+                pending[-1].join(timeout)
 
     # ------------------------------------------------------------- dispatch
 
@@ -762,7 +774,7 @@ class TpuBackend:
             with_embedding=with_embedding,
             created_base=np.int32(self._created_base),
         )
-        return ("small", scores, cand)
+        return self._bg_fetch_small(scores, cand)
 
     def _grid_params(self):
         """Bucket-grid (lo, 1/width) per numeric field for the big kernel."""
@@ -797,6 +809,24 @@ class TpuBackend:
         thread = threading.Thread(target=_fetch, daemon=True)
         thread.start()
         return ("big", cand_dev, holder, thread)
+
+    def _bg_fetch_small(self, scores, cand):
+        """Small-path counterpart of _bg_fetch: both result arrays pull
+        to contiguous host memory in the gap (each synchronous
+        np.asarray on the tunneled runtime costs 10s of ms of fixed
+        latency that otherwise lands in the timed interval)."""
+        holder: dict = {}
+
+        def _fetch(s=scores, c=cand, out=holder):
+            try:
+                out["scores"] = np.ascontiguousarray(np.asarray(s))
+                out["cand"] = np.ascontiguousarray(np.asarray(c))
+            except Exception as e:  # surfaced at collect
+                out["err"] = e
+
+        thread = threading.Thread(target=_fetch, daemon=True)
+        thread.start()
+        return ("small_bg", scores, cand, holder, thread)
 
     def _dispatch_sharded(
         self, slots: np.ndarray, rev: bool, with_should: bool,
@@ -857,7 +887,7 @@ class TpuBackend:
             with_should=with_should,
             with_embedding=with_embedding,
         )
-        return ("small", scores, cand)
+        return self._bg_fetch_small(scores, cand)
 
     def _prewarm_row_bucket(
         self, a_pad, n_cols, rev, with_should, with_embedding, bm, bn
@@ -922,9 +952,15 @@ class TpuBackend:
             # slice of it stays C-contiguous, so no interval-side copy.
             return holder["np"][:n_rows]
 
-        _, scores, cand = pending
-        cand_np = np.asarray(cand)[:n_rows]
-        scores_np = np.asarray(scores)[:n_rows]
+        # Small path: background-fetched like the big path (the fixed
+        # per-transfer latency of a synchronous np.asarray otherwise
+        # lands in the timed interval).
+        _, scores, cand, holder, thread = pending
+        thread.join()
+        if "err" in holder:
+            raise holder["err"]
+        cand_np = holder["cand"][:n_rows]
+        scores_np = holder["scores"][:n_rows]
         # Exact re-sort of each candidate list by (-score, created):
         # the kernel's wait-time epsilon only biased the top-K cutoff.
         created_of = self.meta["created"][np.maximum(cand_np, 0)]

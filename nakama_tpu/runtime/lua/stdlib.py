@@ -120,7 +120,7 @@ def _lua_pattern_to_re(pat: str) -> str:
                     nxt = pat[j + 1]
                     if nxt in _CLASS_BODY:
                         body.append(_CLASS_BODY[nxt])
-                    elif nxt.upper() in _CLASS_BODY and nxt.isupper():
+                    elif nxt.isupper() and nxt.lower() in _CLASS_BODY:
                         raise LuaRuntimeError(
                             f"negated class %{nxt} inside a set is not"
                             " supported"

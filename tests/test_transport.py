@@ -327,3 +327,23 @@ async def test_ws_unsupported_format_rejected():
                 h.url(h.token_for("u1", "alice"), format="msgpack")
             )
             await ws.recv()
+
+
+def test_rtapi_proto_covers_every_envelope_variant():
+    """Drift guard: every envelope key the pipeline dispatches or the
+    server emits must exist in the rtapi Envelope oneof — a new variant
+    added to envelope.py without a proto field would silently drop for
+    protobuf-format clients (encode ignores unknown fields)."""
+    from nakama_tpu.api.envelope import REQUEST_KEYS, RESPONSE_KEYS
+    from nakama_tpu.proto import rtapi_pb2
+
+    oneof_fields = {
+        f.name
+        for f in rtapi_pb2.Envelope.DESCRIPTOR.oneofs_by_name[
+            "message"
+        ].fields
+    }
+    missing = (set(REQUEST_KEYS) | set(RESPONSE_KEYS)) - oneof_fields
+    # status_update is request-only in the oneof but also listed as a
+    # server->client key in envelope.py; one field serves both.
+    assert not missing, f"envelope variants missing from rtapi: {missing}"
