@@ -30,6 +30,51 @@ from .stdlib import from_lua, new_globals, to_lua
 INVOKE_TIMEOUT_SEC = 30.0
 FUEL_PER_INVOCATION = 2_000_000
 
+# nk facade methods exposed to Lua (reference runtime_lua_nakama.go
+# surface, mapped onto runtime/nk.py). Async ones bridge to the event
+# loop from the guest worker thread.
+ASYNC_NK = (
+    "authenticate_device", "authenticate_email", "authenticate_custom",
+    "account_get_id", "accounts_get_id", "account_update_id",
+    "account_delete_id", "users_get_id", "users_get_username",
+    "link_device", "unlink_device", "link_email", "unlink_email",
+    "link_custom", "unlink_custom",
+    "storage_read", "storage_write", "storage_delete", "storage_list",
+    "wallet_update", "wallets_update", "wallet_ledger_list",
+    "multi_update",
+    "notification_send", "notifications_send", "notification_send_all",
+    "match_signal",
+    "leaderboard_create", "leaderboard_delete",
+    "leaderboard_record_write", "leaderboard_records_list",
+    "leaderboard_record_delete",
+    "tournament_create", "tournament_delete", "tournament_join",
+    "tournament_record_write",
+    "friends_list", "friends_add", "friends_delete", "friends_block",
+    "group_create", "group_update", "group_delete", "groups_get_id",
+    "group_users_list", "group_users_add", "group_users_kick",
+    "user_groups_list", "channel_message_send",
+)
+SYNC_NK = (
+    "authenticate_token_generate",
+    "stream_user_list", "stream_user_join", "stream_user_leave",
+    "stream_send", "stream_count",
+    "match_create", "match_get", "match_list", "channel_id_build",
+    "event", "metrics_counter_add", "metrics_gauge_set",
+    "metrics_timer_record",
+    "base64_encode", "base64_decode", "sha256_hash",
+    "hmac_sha256_hash",
+)
+# Methods whose **kwargs accept an options table as the final Lua arg.
+KWARGS_TAIL = frozenset(
+    {
+        "account_update_id", "leaderboard_create",
+        "leaderboard_records_list", "tournament_create",
+        "friends_list", "group_create", "group_update",
+        "group_users_list", "user_groups_list", "match_list",
+        "storage_list", "wallet_ledger_list",
+    }
+)
+
 
 class LuaModule:
     """One loaded .lua module: interpreter + worker thread + nk bridge."""
@@ -171,10 +216,77 @@ class LuaModule:
         reg("uuid_v4", lambda interp: str(uuid.uuid4()))
         reg("time", lambda interp: float(time.time() * 1000))
 
-        # ---- sync nk facade calls
+        # ---- nk facade calls, generically bridged. Positional Lua args
+        # convert via from_lua; for **kwargs-style facade methods
+        # (KWARGS_TAIL) a trailing table splats into keyword arguments —
+        # mirroring the reference Lua API's options-table convention.
+        def _convert_args(name, args):
+            py_args = [from_lua(a) for a in args]
+            kwargs = {}
+            if name in KWARGS_TAIL and py_args and isinstance(
+                py_args[-1], dict
+            ):
+                kwargs = py_args.pop()
+            return py_args, kwargs
+
+        def _convert_out(out):
+            # A Python tuple is Lua MULTIPLE RETURNS (e.g. authenticate_*
+            # returning (user_id, username, created)), not one table.
+            if isinstance(out, tuple):
+                return tuple(to_lua(v) for v in out)
+            return to_lua(out)
+
+        def async_fn(name):
+            def call(interp, *args):
+                py_args, kwargs = _convert_args(name, args)
+                coro = getattr(module.nk, name)(*py_args, **kwargs)
+                return _convert_out(module._await(coro))
+
+            return call
+
+        def sync_fn(name):
+            def call(interp, *args):
+                py_args, kwargs = _convert_args(name, args)
+                return _convert_out(
+                    getattr(module.nk, name)(*py_args, **kwargs)
+                )
+
+            return call
+
+        for name in ASYNC_NK:
+            reg(name, async_fn(name))
+        for name in SYNC_NK:
+            reg(name, sync_fn(name))
+
+        # Byte-oriented helpers: guest strings are BYTE strings (latin-1
+        # on the boundary, matching to_lua's bytes mapping) — without
+        # this, binary data decoded from base64 would re-encode via the
+        # facade's UTF-8 default and corrupt round-trips/digests.
+        def bytes_fn(name):
+            def call(interp, *args):
+                py_args = [
+                    a.encode("latin-1") if isinstance(a, str) else
+                    from_lua(a)
+                    for a in args
+                ]
+                return _convert_out(getattr(module.nk, name)(*py_args))
+
+            return call
+
+        for name in (
+            "base64_encode", "base64_decode", "sha256_hash",
+            "hmac_sha256_hash",
+        ):
+            reg(name, bytes_fn(name))
+
+        # nil-tolerant stream helpers (guest convention: nil stream/data
+        # mean empty — the pre-generic wrappers coerced and modules rely
+        # on it).
         def _stream_send(interp, stream=None, data=None, reliable=True):
             module.nk.stream_send(
-                from_lua(stream) or {}, str(data or ""), bool(reliable)
+                from_lua(stream) or {},
+                str(data) if data is not None else "",
+                bool(reliable),
             )
 
         reg("stream_send", _stream_send)
@@ -184,36 +296,6 @@ class LuaModule:
                 module.nk.stream_count(from_lua(stream) or {})
             ),
         )
-        reg(
-            "match_create",
-            lambda interp, mod=None, params=None: module.nk.match_create(
-                str(mod or ""), from_lua(params) or {}
-            ),
-        )
-        reg(
-            "match_list",
-            lambda interp, limit=None: to_lua(
-                module.nk.match_list(int(limit or 10))
-            ),
-        )
-
-        # ---- async nk facade calls (bridged to the loop)
-        def async_fn(name, convert_out=True):
-            def call(interp, *args):
-                py_args = [from_lua(a) for a in args]
-                coro = getattr(module.nk, name)(*py_args)
-                out = module._await(coro)
-                return to_lua(out) if convert_out else None
-
-            return call
-
-        for name in (
-            "storage_read", "storage_write", "storage_delete",
-            "account_get_id", "users_get_id", "users_get_username",
-            "wallet_update", "notification_send",
-            "leaderboard_record_write", "leaderboard_records_list",
-        ):
-            reg(name, async_fn(name))
 
         return nk_t
 

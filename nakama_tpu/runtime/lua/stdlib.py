@@ -30,6 +30,9 @@ def to_lua(value):
     """Python -> guest value (deep)."""
     if value is None or isinstance(value, (bool, str)):
         return value
+    if isinstance(value, (bytes, bytearray)):
+        # Lua strings are byte strings; latin-1 is the lossless mapping.
+        return bytes(value).decode("latin-1")
     if isinstance(value, (int, float)):
         return float(value)
     if isinstance(value, dict):

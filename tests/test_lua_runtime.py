@@ -329,3 +329,129 @@ def test_lua_malformed_number_is_syntax_error():
 
     with pytest.raises(LuaSyntaxError):
         parse("return 0x", "bad")
+
+
+async def test_lua_nk_bridge_breadth(tmp_path):
+    """The widened nk bridge: guest Lua drives accounts, friends,
+    groups, leaderboards, wallet, notifications, and crypto helpers
+    through the same facade the Python provider uses."""
+    mod_dir = tmp_path / "modules"
+    mod_dir.mkdir()
+    (mod_dir / "breadth.lua").write_text("""
+nk.register_rpc(function(ctx, payload)
+  local uid = ctx.user_id
+  -- wallet + ledger
+  nk.wallet_update(uid, {coins = 25}, {reason = "lua"})
+  local entries = nk.wallet_ledger_list(uid)
+  -- leaderboard
+  nk.leaderboard_create("lua_board", {sort_order = "descending"})
+  nk.leaderboard_record_write("lua_board", uid, ctx.username, 77)
+  local recs = nk.leaderboard_records_list("lua_board", {limit = 10})
+  -- group
+  local g = nk.group_create(uid, "Lua Guild", {open = true})
+  local members = nk.group_users_list(g.id)
+  -- friends via a second account
+  local fid = nk.authenticate_custom("lua-friend-cust-01", "luafriend")
+  nk.friends_add(uid, ctx.username, {fid})
+  local friends = nk.friends_list(uid)
+  -- notification
+  nk.notification_send(uid, "hello", {k = "v"}, 1, "", true)
+  -- crypto helpers
+  local digest = nk.sha256_hash("abc")
+  return json.encode({
+    coins_entries = #entries,
+    top_score = recs.records[1].score,
+    group_name = g.name,
+    member_count = #members.group_users,
+    friend_count = #friends.friends,
+    digest_len = string.len(digest),
+  })
+end, "breadth")
+""")
+    config = Config()
+    config.socket.port = 0
+    config.runtime.path = str(mod_dir)
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    http = aiohttp.ClientSession()
+    try:
+        import base64
+
+        basic = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"defaultkey:").decode()
+        }
+        base = f"http://127.0.0.1:{server.port}"
+        async with http.post(
+            f"{base}/v2/account/authenticate/device",
+            headers=basic,
+            json={"account": {"id": "lua-breadth-000001"},
+                  "username": "luabreadth"},
+        ) as r:
+            session = await r.json()
+        async with http.post(
+            f"{base}/v2/rpc/breadth",
+            headers={"Authorization": f"Bearer {session['token']}"},
+            data=json.dumps(""),
+        ) as r:
+            assert r.status == 200, await r.text()
+            out = json.loads((await r.json())["payload"])
+        assert out["coins_entries"] == 1
+        assert out["top_score"] == 77
+        assert out["group_name"] == "Lua Guild"
+        assert out["member_count"] == 1
+        assert out["friend_count"] == 1
+        assert out["digest_len"] == 64
+    finally:
+        await http.close()
+        await server.stop(0)
+
+
+async def test_lua_binary_round_trip_and_stream_nil(tmp_path):
+    """Review regressions: base64/sha over binary data must round-trip
+    via the latin-1 byte boundary, and stream_send tolerates nil data."""
+    mod_dir = tmp_path / "modules"
+    mod_dir.mkdir()
+    (mod_dir / "bin.lua").write_text("""
+nk.register_rpc(function(ctx, payload)
+  local raw = nk.base64_decode("/wD+")
+  local back = nk.base64_encode(raw)
+  local digest = nk.sha256_hash(raw)
+  nk.stream_send({mode = 6, subject = ctx.user_id}, nil, true)
+  return json.encode({back = back, dlen = string.len(digest)})
+end, "bin")
+""")
+    config = Config()
+    config.socket.port = 0
+    config.runtime.path = str(mod_dir)
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    http = aiohttp.ClientSession()
+    try:
+        import base64
+
+        basic = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"defaultkey:").decode()
+        }
+        base = f"http://127.0.0.1:{server.port}"
+        async with http.post(
+            f"{base}/v2/account/authenticate/device",
+            headers=basic,
+            json={"account": {"id": "lua-bin-000001"}},
+        ) as r:
+            session = await r.json()
+        async with http.post(
+            f"{base}/v2/rpc/bin",
+            headers={"Authorization": f"Bearer {session['token']}"},
+            data=json.dumps(""),
+        ) as r:
+            assert r.status == 200, await r.text()
+            out = json.loads((await r.json())["payload"])
+        assert out["back"] == "/wD+"  # binary survived the boundary
+        import hashlib
+
+        assert out["dlen"] == 64
+    finally:
+        await http.close()
+        await server.stop(0)
