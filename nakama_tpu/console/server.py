@@ -90,6 +90,17 @@ class ConsoleServer:
         r.add_get(
             "/v2/console/leaderboard/{id}", self._h_leaderboard_records
         )
+        r.add_get(
+            "/v2/console/channel/{channel_id}", self._h_channel_messages
+        )
+        r.add_delete(
+            "/v2/console/channel/{channel_id}/message/{message_id}",
+            self._h_channel_message_delete,
+        )
+        r.add_delete(
+            "/v2/console/leaderboard/{id}/owner/{owner_id}",
+            self._h_leaderboard_record_delete,
+        )
         r.add_get("/v2/console/group", self._h_group_list)
         r.add_get("/v2/console/group/{id}/member", self._h_group_members)
         r.add_get("/v2/console/purchase", self._h_purchase_list)
@@ -667,6 +678,61 @@ class ConsoleServer:
         )
 
     # --------------------------------------------------------------- rpc
+
+    async def _h_channel_messages(self, request: web.Request):
+        """Message browse for any channel (reference console.proto
+        ListChannelMessages)."""
+        self._auth(request)
+        from ..api.http import _parse_bool
+        from ..core.channel import ChannelError
+
+        try:
+            result = await self.server.channels.messages_list(
+                request.match_info["channel_id"],
+                limit=int(request.query.get("limit", 100)),
+                forward=_parse_bool(request.query.get("forward", True)),
+                cursor=request.query.get("cursor", ""),
+            )
+        except ChannelError as e:
+            return _err(400, str(e))
+        return web.json_response(result)
+
+    async def _h_channel_message_delete(self, request: web.Request):
+        """Operator message removal (reference console.proto
+        DeleteChannelMessages): through the channel core so the message
+        must belong to the named channel and live subscribers get the
+        MSG_CHAT_REMOVE broadcast — only the sender gate is bypassed."""
+        self._auth(request, write=True)
+        from ..core.channel import ChannelError
+
+        try:
+            await self.server.channels.message_remove(
+                request.match_info["channel_id"],
+                request.match_info["message_id"],
+                authoritative=True,
+            )
+        except ChannelError as e:
+            status = 404 if e.code == "not_found" else 400
+            return _err(status, str(e))
+        return web.json_response({})
+
+    async def _h_leaderboard_record_delete(self, request: web.Request):
+        """Operator record removal (reference console.proto
+        DeleteLeaderboardRecord) — authoritative caller."""
+        self._auth(request, write=True)
+        from ..leaderboard import LeaderboardError
+
+        try:
+            deleted = await self.server.leaderboards.record_delete(
+                request.match_info["id"],
+                request.match_info["owner_id"],
+                caller_authoritative=True,
+            )
+        except LeaderboardError as e:
+            return _err(404, str(e))
+        if not deleted:
+            return _err(404, "record not found")
+        return web.json_response({})
 
     async def _h_group_list(self, request: web.Request):
         """Group browse (reference console_group.go ListGroups)."""

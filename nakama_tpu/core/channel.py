@@ -194,14 +194,24 @@ class Channels:
         message_id: str,
         sender_id: str = "",
         sender_username: str = "",
+        authoritative: bool = False,
     ) -> dict:
+        """`authoritative` (console/runtime callers) skips the sender
+        gate but still requires the message to belong to this channel —
+        and still broadcasts MSG_CHAT_REMOVE to live subscribers."""
         stream = channel_id_to_stream(channel_id)
         row = await self.db.fetch_one(
-            "SELECT sender_id FROM message WHERE id = ?", (message_id,)
+            "SELECT sender_id FROM message WHERE id = ?"
+            " AND stream_mode = ? AND stream_subject = ?"
+            " AND stream_subcontext = ? AND stream_label = ?",
+            (
+                message_id, int(stream.mode), stream.subject,
+                stream.subcontext, stream.label,
+            ),
         )
         if row is None:
             raise ChannelError("message not found", "not_found")
-        if row["sender_id"] != sender_id:
+        if not authoritative and row["sender_id"] != sender_id:
             raise ChannelError(
                 "cannot remove another user's message", "permission_denied"
             )

@@ -466,12 +466,13 @@ class Leaderboards:
                 "permission_denied",
             )
         expiry = lb.expiry_at(time.time())
-        await self.db.execute(
+        deleted = await self.db.execute(
             "DELETE FROM leaderboard_record WHERE leaderboard_id = ?"
             " AND expiry_time = ? AND owner_id = ?",
             (id, expiry, owner_id),
         )
         self.ranks.delete(id, expiry, owner_id)
+        return bool(deleted)
 
     async def records_around_owner(self, *a, **kw):
         return await self.records_haystack(*a, **kw)

@@ -417,3 +417,82 @@ async def test_console_groups_users_and_ui():
     finally:
         await console.close()
         await server.stop(0)
+
+
+async def test_console_channel_browse_delete_and_record_delete():
+    """Console message browse/delete + leaderboard record delete
+    (reference console.proto ListChannelMessages/DeleteChannelMessages/
+    DeleteLeaderboardRecord)."""
+    server = await make_server()
+    console = Console(server)
+    try:
+        await console.login()
+        from nakama_tpu.core import authenticate as core_auth
+
+        uid, _, _ = await core_auth.authenticate_device(
+            server.db, "console-chan-dev", "chanuser", True
+        )
+        # Seed a room message + a leaderboard record via the cores.
+        from nakama_tpu.realtime import Stream, StreamMode
+        from nakama_tpu.core.channel import stream_to_channel_id
+
+        stream = Stream(StreamMode.CHANNEL, label="ops-room")
+        channel_id = stream_to_channel_id(stream)
+        msg = await server.channels.message_send(
+            channel_id, {"text": "hi"}, sender_id=uid,
+            sender_username="chanuser",
+        )
+        await server.leaderboards.create("console-lb")
+        await server.leaderboards.record_write(
+            "console-lb", uid, "chanuser", 42
+        )
+
+        status, listing = await console.call(
+            "GET", f"/v2/console/channel/{channel_id}"
+        )
+        assert status == 200
+        assert [m["message_id"] for m in listing["messages"]] == [
+            msg["message_id"]
+        ]
+
+        # Another (valid) channel must 404: membership is validated.
+        other_id = stream_to_channel_id(
+            Stream(StreamMode.CHANNEL, label="other-room")
+        )
+        status, _ = await console.call(
+            "DELETE",
+            f"/v2/console/channel/{other_id}/message/"
+            f"{msg['message_id']}",
+        )
+        assert status == 404
+        status, _ = await console.call(
+            "DELETE",
+            f"/v2/console/channel/{channel_id}/message/"
+            f"{msg['message_id']}",
+        )
+        assert status == 200
+        status, listing = await console.call(
+            "GET", f"/v2/console/channel/{channel_id}"
+        )
+        assert listing["messages"] == []
+
+        status, recs = await console.call(
+            "GET", "/v2/console/leaderboard/console-lb"
+        )
+        assert status == 200 and len(recs["records"]) == 1
+        status, _ = await console.call(
+            "DELETE",
+            "/v2/console/leaderboard/console-lb/owner/not-a-user"
+        )
+        assert status == 404  # rowcount-0 delete must not report success
+        status, _ = await console.call(
+            "DELETE", f"/v2/console/leaderboard/console-lb/owner/{uid}"
+        )
+        assert status == 200
+        status, recs = await console.call(
+            "GET", "/v2/console/leaderboard/console-lb"
+        )
+        assert recs["records"] == []
+    finally:
+        await console.close()
+        await server.stop(0)
