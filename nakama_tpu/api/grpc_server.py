@@ -157,6 +157,26 @@ ROUTES: dict[str, RouteSpec] = {
         P.DeleteLeaderboardRecordRequest, P.Empty, body=None,
         path_fields=("leaderboard_id",),
     ),
+    "ListTournaments": RouteSpec(
+        "GET", "/v2/tournament", P.ListTournamentsRequest,
+        P.TournamentList, body="query",
+    ),
+    "JoinTournament": RouteSpec(
+        "POST",
+        lambda d: f"/v2/tournament/{d.get('tournament_id', '')}/join",
+        P.JoinTournamentRequest, P.Empty, body=None,
+        path_fields=("tournament_id",),
+    ),
+    "WriteTournamentRecord": RouteSpec(
+        "POST", lambda d: f"/v2/tournament/{d.get('tournament_id', '')}",
+        P.WriteTournamentRecordRequest, P.LeaderboardRecord,
+        path_fields=("tournament_id",),
+    ),
+    "ListTournamentRecords": RouteSpec(
+        "GET", lambda d: f"/v2/tournament/{d.get('tournament_id', '')}",
+        P.ListTournamentRecordsRequest, P.LeaderboardRecordList,
+        body="query", path_fields=("tournament_id",),
+    ),
     "ListNotifications": RouteSpec(
         "GET", "/v2/notification",
         P.ListNotificationsRequest, P.NotificationList, body="query",
@@ -310,14 +330,27 @@ class GrpcGateway:
             data=data,
             headers=headers,
         ) as resp:
-            payload = await resp.json(content_type=None)
-            if resp.status >= 400:
-                code = _STATUS.get(
-                    (payload or {}).get("code", 13), grpc.StatusCode.INTERNAL
-                )
-                raise _ApiStatusError(
-                    code, (payload or {}).get("message", "")
-                )
+            try:
+                payload = await resp.json(content_type=None)
+            except ValueError:
+                # Router-level errors (e.g. an empty path segment hits
+                # aiohttp's own plain-text 404) carry no JSON body; map
+                # the HTTP status instead of surfacing a parser error.
+                payload = None
+            if resp.status >= 400 or payload is None:
+                if isinstance(payload, dict):
+                    code = _STATUS.get(
+                        payload.get("code", 13), grpc.StatusCode.INTERNAL
+                    )
+                    message = payload.get("message", "")
+                else:
+                    code = {
+                        400: grpc.StatusCode.INVALID_ARGUMENT,
+                        404: grpc.StatusCode.NOT_FOUND,
+                        405: grpc.StatusCode.INVALID_ARGUMENT,
+                    }.get(resp.status, grpc.StatusCode.INTERNAL)
+                    message = f"HTTP {resp.status}"
+                raise _ApiStatusError(code, message)
         return json_format.ParseDict(
             payload or {}, spec.response(), ignore_unknown_fields=True
         )

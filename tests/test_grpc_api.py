@@ -266,3 +266,71 @@ def test_every_service_method_has_a_gateway_route():
         spec = ROUTES[m.name]
         assert spec.request.DESCRIPTOR is m.input_type, m.name
         assert spec.response.DESCRIPTOR is m.output_type, m.name
+
+
+async def test_grpc_tournaments():
+    server = await make_server()
+    await server.tournaments.create(
+        "grpc-cup", title="gRPC Cup", category=3, duration=3600,
+        join_required=False, authoritative=False,
+    )
+    c = Client(server)
+    try:
+        req = P.AuthenticateRequest(username="cupper")
+        req.account.update({"id": "device-grpc-cup-01"})
+        s = await c.call(
+            "AuthenticateDevice", req, P.Session, auth=server_key_auth()
+        )
+        bearer = f"Bearer {s.token}"
+
+        listing = await c.call(
+            "ListTournaments", P.ListTournamentsRequest(), P.TournamentList,
+            auth=bearer,
+        )
+        assert any(t.id == "grpc-cup" for t in listing.tournaments)
+
+        await c.call(
+            "JoinTournament",
+            P.JoinTournamentRequest(tournament_id="grpc-cup"),
+            P.Empty, auth=bearer,
+        )
+        rec = await c.call(
+            "WriteTournamentRecord",
+            P.WriteTournamentRecordRequest(
+                tournament_id="grpc-cup", score=99
+            ),
+            P.LeaderboardRecord, auth=bearer,
+        )
+        assert rec.score == 99
+        recs = await c.call(
+            "ListTournamentRecords",
+            P.ListTournamentRecordsRequest(tournament_id="grpc-cup"),
+            P.LeaderboardRecordList, auth=bearer,
+        )
+        assert recs.records[0].username == "cupper"
+    finally:
+        await c.close()
+        await server.stop()
+
+
+async def test_grpc_empty_path_id_maps_to_not_found():
+    """Regression: an empty path id hits aiohttp's plain-text 404 — the
+    gateway must map it to NOT_FOUND, not an INTERNAL JSON-parse error."""
+    server = await make_server()
+    c = Client(server)
+    try:
+        req = P.AuthenticateRequest()
+        req.account.update({"id": "device-grpc-empty-01"})
+        s = await c.call(
+            "AuthenticateDevice", req, P.Session, auth=server_key_auth()
+        )
+        with pytest.raises(grpc.aio.AioRpcError) as err:
+            await c.call(
+                "JoinTournament",
+                P.JoinTournamentRequest(tournament_id=""),
+                P.Empty, auth=f"Bearer {s.token}",
+            )
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await c.close()
+        await server.stop()
