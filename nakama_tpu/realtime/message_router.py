@@ -12,7 +12,25 @@ from ..logger import Logger
 from ..metrics import Metrics
 from .session_registry import LocalSessionRegistry
 from .tracker import LocalTracker
-from .types import PresenceEvent, PresenceID, Stream
+from .types import PresenceEvent, PresenceID, Stream, StreamMode
+
+
+def _valid_chat_stream(stream: Stream) -> bool:
+    """The shape rules channel_id_to_stream enforces on parse
+    (core/channel.py:86-91) — a chat-mode presence event may only carry
+    a channel id a client can echo back."""
+    mode = stream.mode
+    if mode == StreamMode.CHANNEL:
+        return bool(stream.label) and not (
+            stream.subject or stream.subcontext
+        )
+    if mode == StreamMode.GROUP:
+        return bool(stream.subject) and not (
+            stream.subcontext or stream.label
+        )
+    return bool(stream.subject) and bool(stream.subcontext) and not (
+        stream.label
+    )
 
 
 class LocalMessageRouter:
@@ -56,18 +74,65 @@ class LocalMessageRouter:
             self.send_to_presence_ids(presence_ids, envelope)
 
     def route_presence_event(self, event: PresenceEvent):
-        """Client-facing stream presence events: joins/leaves on a stream are
+        """Client-facing presence events: joins/leaves on a stream are
         delivered to the stream's remaining presences, hidden presences
-        excluded from the payload (reference tracker.go:1014-1096)."""
+        excluded from the payload. The envelope variant SPECIALIZES by
+        stream mode exactly as the reference does (tracker.go:1060-1117):
+        chat streams emit channel_presence_event with their identity
+        fields, match streams match_presence_event, party streams
+        party_presence_event; everything else the generic stream event."""
         joins = [p.as_dict() for p in event.joins if not p.meta.hidden]
         leaves = [p.as_dict() for p in event.leaves if not p.meta.hidden]
         if not joins and not leaves:
             return
-        envelope = {
-            "stream_presence_event": {
-                "stream": event.stream.as_dict(),
+        stream = event.stream
+        mode = stream.mode
+        if mode in (
+            StreamMode.CHANNEL, StreamMode.GROUP, StreamMode.DM
+        ) and _valid_chat_stream(stream):
+            # Irregular chat-mode streams (not built by the channel
+            # core) fall through to the generic event below rather than
+            # emitting a channel id no client could echo back (the
+            # reference logs + skips, tracker.go:1062).
+            from ..core.channel import stream_to_channel_id
+
+            body: dict = {
+                "channel_id": stream_to_channel_id(stream),
                 "joins": joins,
                 "leaves": leaves,
             }
-        }
+            if mode == StreamMode.CHANNEL:
+                body["room_name"] = stream.label
+            elif mode == StreamMode.GROUP:
+                body["group_id"] = stream.subject
+            else:
+                body["user_id_one"] = stream.subject
+                body["user_id_two"] = stream.subcontext
+            envelope = {"channel_presence_event": body}
+        elif mode in (
+            StreamMode.MATCH_RELAYED, StreamMode.MATCH_AUTHORITATIVE
+        ):
+            envelope = {
+                "match_presence_event": {
+                    "match_id": stream.subject,
+                    "joins": joins,
+                    "leaves": leaves,
+                }
+            }
+        elif mode == StreamMode.PARTY:
+            envelope = {
+                "party_presence_event": {
+                    "party_id": stream.subject,
+                    "joins": joins,
+                    "leaves": leaves,
+                }
+            }
+        else:
+            envelope = {
+                "stream_presence_event": {
+                    "stream": stream.as_dict(),
+                    "joins": joins,
+                    "leaves": leaves,
+                }
+            }
         self.send_to_stream(event.stream, envelope)

@@ -59,7 +59,10 @@ async def test_presence_events_fan_out_to_stream():
         await tracker.drain()
         tracker.track("sb", room, "ub", PresenceMeta(username="bob"))
         await tracker.drain()
-        # Alice sees bob's join (and her own initial join).
+        # Alice sees bob's join (and her own initial join). This stream
+        # is IRREGULAR chat-mode (subject, no label) so the router falls
+        # back to the generic event (regular streams specialize — see
+        # test_presence_events_specialize_by_stream_mode).
         joins = [
             e["stream_presence_event"]["joins"]
             for e in a.sent
@@ -242,3 +245,59 @@ async def test_status_follow_by_username_over_server():
         await watcher.close()
     finally:
         await server.stop(0)
+
+
+async def test_presence_events_specialize_by_stream_mode():
+    """Reference tracker.go:1060-1117: chat streams emit
+    channel_presence_event with their identity fields, match streams
+    match_presence_event, party streams party_presence_event; only
+    irregular streams fall back to the generic stream event."""
+    from nakama_tpu.core.channel import stream_to_channel_id
+
+    _, sessions, tracker, router = make_stack()
+    tracker.start()
+    try:
+        a, b = FakeSession("sa", "ua"), FakeSession("sb", "ub")
+        sessions.add(a)
+        sessions.add(b)
+
+        room = Stream(StreamMode.CHANNEL, label="lobby")
+        tracker.track("sa", room, "ua", PresenceMeta(username="alice"))
+        await tracker.drain()
+        tracker.track("sb", room, "ub", PresenceMeta(username="bob"))
+        await tracker.drain()
+        ch_events = [
+            e["channel_presence_event"]
+            for e in a.sent
+            if "channel_presence_event" in e
+        ]
+        assert ch_events, a.sent
+        assert ch_events[-1]["channel_id"] == stream_to_channel_id(room)
+        assert ch_events[-1]["room_name"] == "lobby"
+        assert ch_events[-1]["joins"][0]["username"] == "bob"
+
+        match = Stream(StreamMode.MATCH_RELAYED, subject="m-1")
+        tracker.track("sa", match, "ua", PresenceMeta(username="alice"))
+        await tracker.drain()
+        tracker.track("sb", match, "ub", PresenceMeta(username="bob"))
+        await tracker.drain()
+        m_events = [
+            e["match_presence_event"]
+            for e in a.sent
+            if "match_presence_event" in e
+        ]
+        assert m_events and m_events[-1]["match_id"] == "m-1"
+
+        party = Stream(StreamMode.PARTY, subject="p-1")
+        tracker.track("sa", party, "ua", PresenceMeta(username="alice"))
+        await tracker.drain()
+        tracker.track("sb", party, "ub", PresenceMeta(username="bob"))
+        await tracker.drain()
+        p_events = [
+            e["party_presence_event"]
+            for e in a.sent
+            if "party_presence_event" in e
+        ]
+        assert p_events and p_events[-1]["party_id"] == "p-1"
+    finally:
+        tracker.stop()
