@@ -15,22 +15,22 @@ from .tracker import LocalTracker
 from .types import PresenceEvent, PresenceID, Stream, StreamMode
 
 
-def _valid_chat_stream(stream: Stream) -> bool:
-    """The shape rules channel_id_to_stream enforces on parse
-    (core/channel.py:86-91) — a chat-mode presence event may only carry
-    a channel id a client can echo back."""
-    mode = stream.mode
-    if mode == StreamMode.CHANNEL:
-        return bool(stream.label) and not (
-            stream.subject or stream.subcontext
-        )
-    if mode == StreamMode.GROUP:
-        return bool(stream.subject) and not (
-            stream.subcontext or stream.label
-        )
-    return bool(stream.subject) and bool(stream.subcontext) and not (
-        stream.label
+def _chat_channel_id(stream: Stream) -> str | None:
+    """The channel id for a chat-mode stream, or None for irregular
+    shapes. ONE rule set: build the id and let channel_id_to_stream — the
+    parser every client echo goes through — accept or reject it."""
+    from ..core.channel import (
+        ChannelError,
+        channel_id_to_stream,
+        stream_to_channel_id,
     )
+
+    channel_id = stream_to_channel_id(stream)
+    try:
+        channel_id_to_stream(channel_id)
+    except ChannelError:
+        return None
+    return channel_id
 
 
 class LocalMessageRouter:
@@ -87,17 +87,18 @@ class LocalMessageRouter:
             return
         stream = event.stream
         mode = stream.mode
-        if mode in (
-            StreamMode.CHANNEL, StreamMode.GROUP, StreamMode.DM
-        ) and _valid_chat_stream(stream):
+        channel_id = (
+            _chat_channel_id(stream)
+            if mode in (StreamMode.CHANNEL, StreamMode.GROUP, StreamMode.DM)
+            else None
+        )
+        if channel_id is not None:
             # Irregular chat-mode streams (not built by the channel
             # core) fall through to the generic event below rather than
             # emitting a channel id no client could echo back (the
             # reference logs + skips, tracker.go:1062).
-            from ..core.channel import stream_to_channel_id
-
             body: dict = {
-                "channel_id": stream_to_channel_id(stream),
+                "channel_id": channel_id,
                 "joins": joins,
                 "leaves": leaves,
             }

@@ -33,11 +33,20 @@ class Swarm:
         self.matches: list[str] = []
 
     async def client(self, i):
+        from nakama_tpu.api import protocol
+
         rng = random.Random(i * 7919 + 17)
+        # Half the swarm speaks protobuf: the soak invariants hold for
+        # BOTH wire formats simultaneously on one server.
+        fmt = "protobuf" if i % 2 else "json"
         token = self.server.issue_session(f"user-{i}", f"name{i}")
         ws = await websockets.connect(
             f"ws://127.0.0.1:{self.server.port}/ws?token={token}"
+            f"&format={fmt}"
         )
+
+        def decode(raw):
+            return protocol.decode(raw, fmt)
 
         async def drain():
             # RUNTIME_EXCEPTION (code 0) marks an unstructured failure —
@@ -46,8 +55,12 @@ class Swarm:
             try:
                 while True:
                     raw = await asyncio.wait_for(ws.recv(), 0.01)
-                    e = json.loads(raw)
-                    if "error" in e and e["error"].get("code") == 0:
+                    e = decode(raw)
+                    # Proto decode omits default-valued fields, so a
+                    # code-0 (RUNTIME_EXCEPTION) error arrives with NO
+                    # "code" key — missing must default to 0 or the
+                    # invariant is dead for the protobuf half.
+                    if "error" in e and e["error"].get("code", 0) == 0:
                         self.internal_errors.append(e)
             except asyncio.TimeoutError:
                 return
@@ -109,18 +122,18 @@ class Swarm:
             for _ in range(OPS_PER_CLIENT):
                 envelope = rng.choice(ops)()
                 envelope["cid"] = str(rng.random())
-                await ws.send(json.dumps(envelope))
+                await ws.send(protocol.encode(envelope, fmt))
                 await drain()
                 # Track created parties/matches for cross-client joins.
                 try:
                     while True:
                         raw = await asyncio.wait_for(ws.recv(), 0.005)
-                        e = json.loads(raw)
+                        e = decode(raw)
                         if "party" in e and "party_id" in e.get("party", {}):
                             self.parties.append(e["party"]["party_id"])
                         if "match" in e and "match_id" in e.get("match", {}):
                             self.matches.append(e["match"]["match_id"])
-                        if "error" in e and e["error"].get("code") == 0:
+                        if "error" in e and e["error"].get("code", 0) == 0:
                             self.internal_errors.append(e)
                 except asyncio.TimeoutError:
                     pass
