@@ -192,14 +192,14 @@ ROUTES: dict[str, RouteSpec] = {
 }
 
 for _provider in (
-    "device", "email", "custom", "apple", "facebook", "google", "steam",
+    "device", "email", "custom", "apple", "facebook",
+    "facebookinstantgame", "gamecenter", "google", "steam",
 ):
-    ROUTES[f"Authenticate{_provider.capitalize()}"] = RouteSpec(
+    cap = _provider.capitalize()
+    ROUTES[f"Authenticate{cap}"] = RouteSpec(
         "POST", f"/v2/account/authenticate/{_provider}",
         P.AuthenticateRequest, P.Session,
     )
-for _provider in ("device", "email", "custom"):
-    cap = _provider.capitalize()
     ROUTES[f"Link{cap}"] = RouteSpec(
         "POST", f"/v2/account/link/{_provider}", P.LinkRequest, P.Empty,
         transform=_flatten_account,
