@@ -233,6 +233,55 @@ class PoolBuffer:
         # matched-removal tail. Correctness needs rm applied before the
         # next kernel pass, and every dispatch flushes first.
 
+    def prewarm(self):
+        """Compile both add-scatter pad shapes (small tail + full chunk)
+        on a daemon thread: the first naturally-occurring small tail
+        otherwise pays its multi-second XLA compile inside a timed
+        interval (jit cache is process-wide; the dummy scatter rewrites
+        identical rows, a no-op on pool contents)."""
+        if getattr(self, "_prewarmed", False) or self.sharding is not None:
+            # Sharded pools: a scratch clone would donate unsharded
+            # buffers into the sharded scatter (warning + no reuse);
+            # the mesh path tolerates the one-off compile instead.
+            return
+        self._prewarmed = True
+        import threading
+
+        scatter = self._scatter
+        shapes = {k: (v.shape, v.dtype) for k, v in self.device.items()}
+
+        def _warm():
+            try:
+                for u_pad in (max(256, self.flush_chunk // 4),
+                              self.flush_chunk):
+                    # Scratch pool of identical shapes: the jit cache keys
+                    # on abstract signatures, so the compile carries over
+                    # to the real pool while self.device (donated by real
+                    # flushes) is never touched off-thread.
+                    scratch = {
+                        k: jnp.zeros(shp, dt)
+                        for k, (shp, dt) in shapes.items()
+                    }
+                    idx = jnp.zeros(u_pad, dtype=jnp.int32)
+                    rows = {
+                        k: jnp.zeros((u_pad,) + shp[1:], dt)
+                        for k, (shp, dt) in shapes.items()
+                    }
+                    out = scatter(scratch, idx, rows)
+                    jax.block_until_ready(out)
+            except Exception as e:
+                # One-shot: a persistent failure (device OOM on the
+                # scratch clone) must not silently re-spawn an allocating
+                # thread every flush. The real flush then just pays its
+                # own compile.
+                import logging
+
+                logging.getLogger("nakama_tpu.matchmaker").warning(
+                    "pool scatter prewarm failed: %s", e
+                )
+
+        threading.Thread(target=_warm, daemon=True).start()
+
     def flush(self):
         """Apply queued updates: one flags-invalidate scatter for removals
         (4B/slot) + one row scatter for adds, removals first so a freed
@@ -243,6 +292,8 @@ class PoolBuffer:
         bucket instead of one per distinct update count."""
         if self._stage_n == 0 and not self._pending_rm:
             return
+        if not getattr(self, "_prewarmed", False):
+            self.prewarm()
         rm_parts = self._pending_rm
         self._pending_rm = []
         self._pending_rm_n = 0
@@ -274,7 +325,12 @@ class PoolBuffer:
             self._stage_pos = {}
             if u:
                 self._pending_add_mask[idx_v] = False
-                u_pad = self.flush_chunk  # n <= chunk by construction
+                # Small tail bucket: the interval-start tail flush is
+                # usually a few hundred rows; padding those to the full
+                # chunk measured ~2/3 of the flush span. Two compiled
+                # scatter shapes total (small, chunk).
+                small = max(256, self.flush_chunk // 4)
+                u_pad = small if u <= small else self.flush_chunk
                 idx = np.empty(u_pad, dtype=np.int32)
                 idx[:u] = idx_v
                 idx[u:] = idx_v[-1]

@@ -542,7 +542,10 @@ class LocalMatchmaker:
         # slots (a duplicate would double-free into the allocator).
         slots = np.unique(np.asarray(slots, dtype=np.int32))
         self.backend.on_remove_slots(slots)
-        self.store.remove_slots(slots)
+        # Eager teardown: API removals are small, and immediate slot free
+        # keeps LIFO reuse (pool density). Only the interval's bulk
+        # matched-removal defers to the idle-gap drain.
+        self.store.remove_slots(slots, defer_free=False)
 
     def _unregister(self, ticket_id: str):
         slot = self.store.slot_by_id(ticket_id)

@@ -127,6 +127,7 @@ class SlotStore:
         # reused slots (tpu.py).
         self.gen = np.zeros(capacity, dtype=np.int64)
         self.n_active = 0  # O(1) gauge (the masks are O(capacity) to sum)
+        self.n_live = 0  # O(1) len() (maps lag removals until drain)
         # LIFO free stack (numpy: bulk push is one slice write); top at
         # index _free_n-1, initialized so slot 0 pops first (density).
         self._free = np.arange(capacity - 1, -1, -1, dtype=np.int32)
@@ -137,16 +138,18 @@ class SlotStore:
             self.maps = native.TickStore(capacity, max_party_size)
         except Exception:
             self.maps = PyTickStore(capacity)
-        self._graveyard: list[np.ndarray] = []
+        self._graveyard: list[tuple[np.ndarray, np.ndarray]] = []
 
     def __len__(self) -> int:
-        return len(self.maps)
+        return self.n_live
 
     # ------------------------------------------------------------ mutation
 
     def add(self, ticket: MatchmakerTicket, active: bool = True) -> int:
         """Assign a slot and register the ticket. Raises on capacity/dup;
         leaves no partial state behind on failure."""
+        if self._free_n == 0 and self._graveyard:
+            self.drain()  # lazily-freed slots cover the shortfall
         if self._free_n == 0:
             raise RuntimeError("matchmaker pool capacity exceeded")
         sessions = sorted(ticket.session_ids)
@@ -159,13 +162,32 @@ class SlotStore:
             [_hash_id(s) for s in sessions], dtype=np.uint64
         )
         slot = int(self._free[self._free_n - 1])
-        self.maps.add(
-            slot,
-            _hash_id(ticket.ticket),
-            sh,
-            _hash_id(ticket.party_id) if ticket.party_id else 0,
-        )
+        try:
+            self.maps.add(
+                slot,
+                _hash_id(ticket.ticket),
+                sh,
+                _hash_id(ticket.party_id) if ticket.party_id else 0,
+            )
+        except KeyError:
+            if not self._graveyard:
+                raise
+            # The duplicate id may be an undrained removed ticket
+            # (remove-then-readd within one interval gap): settle the
+            # lazy removals and retry once. drain() pushes freed slots on
+            # top of the stack, so the slot MUST be re-popped — retrying
+            # with the pre-drain slot would leave the actually-allocated
+            # top-of-stack slot on the free list (allocator poison).
+            self.drain()
+            slot = int(self._free[self._free_n - 1])
+            self.maps.add(
+                slot,
+                _hash_id(ticket.ticket),
+                sh,
+                _hash_id(ticket.party_id) if ticket.party_id else 0,
+            )
         self._free_n -= 1
+        self.n_live += 1
         m = self.meta
         m["min_count"][slot] = ticket.min_count
         m["max_count"][slot] = ticket.max_count
@@ -185,33 +207,41 @@ class SlotStore:
         return slot
 
     def remove_slots(self, slots: np.ndarray, defer_free: bool = True):
-        """Bulk unregistration (matched/removed tickets). Object refs move
-        to the graveyard; `drain()` frees them off the critical path
-        (`defer_free=False` skips the parking — small rollback paths).
+        """Bulk unregistration (matched/removed tickets). The interval
+        path (defer_free=True) flips only the alive/active masks NOW —
+        the authoritative liveness every consumer checks — and parks the
+        rest of the teardown (reverse-map removal, object-ref clearing,
+        free-list push) for `drain()` in the interval's idle gap: at
+        ~100k matched slots the native map removal + object fancy
+        indexing measured ~15ms of interval tail. `defer_free=False`
+        (small rollback paths) tears down eagerly.
 
         Returns the parked object-ref array (ticket_at[slots]) so the
         caller can reuse it (MatchBatch snapshot) without a second
         O(entries) fancy index.
 
-        `slots` must be duplicate-free: the interval path guarantees it by
-        construction (matches are slot-disjoint); API paths dedupe in
+        `slots` must be duplicate-free AND alive: the interval path
+        guarantees it by construction (matches are slot-disjoint); API
+        paths resolve via slot_by_id (alive-checked) and dedupe in
         LocalMatchmaker._remove_slots. A duplicate here would double-free
         the slot into the free list and poison the allocator."""
         if len(slots) == 0:
             return None
         slots = np.asarray(slots, dtype=np.int32)
-        self.maps.remove_slots(slots)
         objs = self.ticket_at[slots]
-        if defer_free:
-            self._graveyard.append(objs)
-        self.ticket_at[slots] = None
         self.alive[slots] = False
         self.n_active -= int(self.active[slots].sum())
         self.active[slots] = False
-        self.meta["session_counts"][slots] = 0
-        n = len(slots)
-        self._free[self._free_n : self._free_n + n] = slots
-        self._free_n += n
+        self.n_live -= len(slots)
+        if defer_free:
+            self._graveyard.append((slots, objs))
+        else:
+            self.maps.remove_slots(slots)
+            self.ticket_at[slots] = None
+            self.meta["session_counts"][slots] = 0
+            n = len(slots)
+            self._free[self._free_n : self._free_n + n] = slots
+            self._free_n += n
         return objs
 
     def deactivate(self, slots: np.ndarray):
@@ -237,14 +267,26 @@ class SlotStore:
         return slot
 
     def drain(self):
-        """Free removed ticket objects; called from the interval idle gap."""
-        self._graveyard.clear()
+        """Settle lazily-removed slots (reverse maps, object refs, free
+        list) and release the parked objects; called from the interval
+        idle gap, and on-demand when the allocator or a duplicate-id add
+        needs undrained slots settled early."""
+        parked, self._graveyard = self._graveyard, []
+        for slots, _objs in parked:
+            self.maps.remove_slots(slots)
+            self.ticket_at[slots] = None
+            self.meta["session_counts"][slots] = 0
+            n = len(slots)
+            self._free[self._free_n : self._free_n + n] = slots
+            self._free_n += n
 
     # ------------------------------------------------------------- queries
 
     def slot_by_id(self, ticket_id: str) -> int | None:
         slot = self.maps.slot_of(_hash_id(ticket_id))
-        if slot is None:
+        if slot is None or not self.alive[slot]:
+            # alive is the authority: a lazily-removed slot still resolves
+            # in the maps until drain().
             return None
         t = self.ticket_at[slot]
         # 64-bit collision guard: verify the resolved object really is it.
@@ -260,16 +302,24 @@ class SlotStore:
         return self.slot_by_id(ticket_id) is not None
 
     def session_ticket_count(self, session_id: str) -> int:
-        return self.maps.session_count(_hash_id(session_id))
+        # alive filter: lazily-removed slots stay mapped until drain and
+        # must not count against MaxTickets.
+        slots = self.maps.session_slots(_hash_id(session_id))
+        return int(self.alive[slots].sum())
 
     def party_ticket_count(self, party_id: str) -> int:
-        return self.maps.party_count(_hash_id(party_id))
+        slots = self.maps.party_slots(_hash_id(party_id))
+        return int(self.alive[slots].sum())
 
     def session_tickets(self, session_id: str) -> list[MatchmakerTicket]:
         out = []
         for slot in self.maps.session_slots(_hash_id(session_id)):
             t = self.ticket_at[slot]
-            if t is not None and session_id in t.session_ids:
+            if (
+                self.alive[slot]
+                and t is not None
+                and session_id in t.session_ids
+            ):
                 out.append(t)
         return out
 
@@ -277,7 +327,7 @@ class SlotStore:
         out = []
         for slot in self.maps.party_slots(_hash_id(party_id)):
             t = self.ticket_at[slot]
-            if t is not None and t.party_id == party_id:
+            if self.alive[slot] and t is not None and t.party_id == party_id:
                 out.append(t)
         return out
 

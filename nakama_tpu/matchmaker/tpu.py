@@ -79,11 +79,9 @@ def _pow2_blocks(blocks: int) -> int:
 
 
 def _work_ready(work: tuple) -> bool:
-    """Has this dispatched work's device compute + D2H completed?"""
-    pending = work[0]
-    if pending[0] in ("big", "small_bg"):
-        return not pending[-1].is_alive()
-    return True  # oracle-path results are already host-side
+    """Has this dispatched work's device compute + D2H + gap-side
+    assembly completed?"""
+    return not work[0][-1].is_alive()
 
 
 class TpuBackend:
@@ -216,6 +214,12 @@ class TpuBackend:
         self._should_count = 0
         self._emb_mask = np.zeros(cap, dtype=bool)
         self._emb_count = 0
+        # Pure-pairs pool tracking (device_pairing gate): a ticket is
+        # "pair-shaped" iff solo 1v1 (min==max==2, one presence,
+        # count_multiple 1|2). The synchronous interval path can then run
+        # grouping on device (device2.pair_partners).
+        self._nonpair_mask = np.zeros(cap, dtype=bool)
+        self._nonpair_count = 0
         # Per-process scratch: slots already claimed by an accepted match
         # this interval (reset each process_slots call).
         self._sel_mask = np.zeros(cap, dtype=bool)
@@ -232,6 +236,20 @@ class TpuBackend:
         self._in_flight_mask = np.zeros(cap, dtype=bool)
         # Row-bucket shapes already compiled (or prewarmed) this process.
         self._warmed_buckets: set[tuple] = set()
+        # Insertion-ordered slot ring: adds append here, so the ring IS
+        # the (created_at, created_seq) dispatch order — the per-dispatch
+        # lexsort over ~100k actives measured 8.7ms/interval. Entries of
+        # reused slots are invalidated on re-add; a non-monotone
+        # created_at (clock step, cross-node insert()) flags the ring
+        # unsorted and dispatch falls back to the exact lexsort until the
+        # next compaction re-sorts it.
+        self._ring = np.empty(2 * cap, dtype=np.int32)
+        self._ring_valid = np.zeros(2 * cap, dtype=bool)
+        self._ring_pos = np.full(cap, -1, dtype=np.int64)
+        self._ring_n = 0
+        self._ring_last_created = np.iinfo(np.int64).min
+        self._ring_unsorted = False
+        self._dev_mask_scratch = np.zeros(cap, dtype=bool)
         # query string -> CompiledQuery | None (None = host-only).
         self._cq_cache: dict[str, CompiledQuery | None] = {}
         # Observed numeric value range per field (bucket grid for the MXU
@@ -343,6 +361,7 @@ class TpuBackend:
         self.pool.add(slot, row)
         if len(self.store) == 1:
             self._created_base = ticket.created_seq
+        self._ring_append(slot)
         self._in_flight_mask[slot] = False  # slot reuse: new ticket
         self.host_only_mask[slot] = host_only
         if host_only:
@@ -364,6 +383,14 @@ class TpuBackend:
         has_emb = ticket.embedding is not None
         self._emb_mask[slot] = has_emb
         self._emb_count += has_emb
+        nonpair = not (
+            ticket.min_count == 2
+            and ticket.max_count == 2
+            and ticket.count == 1
+            and ticket.count_multiple in (1, 2)
+        )
+        self._nonpair_mask[slot] = nonpair
+        self._nonpair_count += nonpair
 
         ex = self.exact
         num64, str64 = exact_features(ticket, self.registry)
@@ -411,6 +438,8 @@ class TpuBackend:
         self._should_mask[slots] = False
         self._emb_count -= int(self._emb_mask[slots].sum())
         self._emb_mask[slots] = False
+        self._nonpair_count -= int(self._nonpair_mask[slots].sum())
+        self._nonpair_mask[slots] = False
         self._in_flight_mask[slots] = False
 
     # -------------------------------------------------------------- process
@@ -489,25 +518,23 @@ class TpuBackend:
 
         work = None
         if len(device_slots):
-            # Oldest-first fairness for the greedy assembler (lexsort:
-            # primary created_at ns, tie created_seq).
-            order = np.lexsort(
-                (
-                    meta["created_seq"][device_slots],
-                    meta["created"][device_slots],
-                )
+            # Oldest-first fairness for the greedy assembler: primary
+            # created_at ns, tie created_seq — normally free via the
+            # insertion-ordered ring, exact lexsort as fallback.
+            device_slots, device_last = self._order_dispatch(
+                device_slots, device_last
             )
-            device_slots = np.ascontiguousarray(device_slots[order])
-            device_last = device_last[order]
             with span(crumb, "flush_s"):
                 self.pool.flush()
             with span(crumb, "dispatch_s"):
-                pending = self._dispatch(device_slots, rev_precision)
+                pending = self._dispatch(
+                    device_slots, device_last, rev_precision
+                )
             gen_snap = self.store.gen.copy() if pipelined else self.store.gen
             work = (
                 pending,
                 device_slots,
-                np.ascontiguousarray(device_last, dtype=np.uint8),
+                device_last,
                 len(device_slots),
                 gen_snap,
             )
@@ -604,34 +631,14 @@ class TpuBackend:
                     w_slots[w_gen[w_slots] == self.store.gen[w_slots]]
                 ] = False
             with span(crumb, "collect_s"):
-                cand_np = self._collect(w_pending, w_n)
-            with span(crumb, "assemble_s"):
-                # Exact query validation runs INSIDE the assembler (f64
-                # mirrors, struct Exact): an imprecision-admitted candidate
-                # is skipped there and assembly continues with the next
-                # hit — matching the reference, whose index search never
-                # returns non-matching hits. Only matches flagged
-                # needs_host (host-only member under mutual validation)
-                # fall back to the AST check below.
-                n_matches, offsets, flat, needs_host = native.assemble_arrays(
-                    w_slots,
-                    w_last,
-                    cand_np,
-                    min_count=meta["min_count"],
-                    max_count=meta["max_count"],
-                    count_multiple=meta["count_multiple"],
-                    count=meta["count"],
-                    intervals=meta["intervals"],
-                    created=meta["created"],
-                    session_hashes=meta["session_hashes"],
-                    session_counts=meta["session_counts"],
-                    exact=self.exact,
-                    rev=rev_precision,
-                )
-            with span(crumb, "validate_s"):
-                ok = self._validate_flagged(
-                    n_matches, offsets, flat, needs_host, rev_precision
-                )
+                # Fetch + exact-ordering + native assembly + host
+                # validation all ran on the cohort's worker thread in the
+                # interval gap (_bg_asm); a ready cohort hands back
+                # finished matches and this join is free. Staleness from
+                # gap-time assembly (a slot reused or removed while the
+                # thread ran) is exactly the staleness the accept step
+                # below already drops via gen/alive masks.
+                n_matches, offsets, flat, ok = self._collect(w_pending)
             with span(crumb, "accept_s"):
                 total = int(offsets[n_matches])
                 flat_t = flat[:total]
@@ -649,7 +656,12 @@ class TpuBackend:
                     | sel[flat_t]
                 )
                 bad = ~ok
-                np.logical_or.at(bad, mid, bad_e)
+                if bad_e.any():
+                    # bincount over the bad entries' match ids: ~10x the
+                    # buffered np.logical_or.at at 100k entries.
+                    bad |= (
+                        np.bincount(mid[bad_e], minlength=n_matches) > 0
+                    )
                 if pipelined and bad.any():
                     # Only the pipeline lag can strand an inactive ticket;
                     # non-pipelined drops keep reference single-shot
@@ -690,26 +702,112 @@ class TpuBackend:
         return batch, matched_slots, reactivate
 
     def wait_idle(self, timeout: float | None = None):
-        """Block until every dispatched cohort's compute + D2H completed
-        (the results stay queued for the next process() to collect). Used
-        between intervals by the bench to model the production interval
-        gap, and at shutdown so no fetch thread outlives the runtime."""
+        """Block until every dispatched cohort's compute + D2H + gap-side
+        assembly completed (the results stay queued for the next process()
+        to collect). Used between intervals by the bench to model the
+        production interval gap, and at shutdown so no worker thread
+        outlives the runtime."""
         for work in list(self._pipeline_queue):
-            pending = work[0]
-            if pending[0] in ("big", "small_bg"):
-                pending[-1].join(timeout)
+            work[0][-1].join(timeout)
+
+    # ----------------------------------------------------- dispatch order
+
+    def _ring_append(self, slot: int):
+        if self._ring_n == len(self._ring):
+            self._ring_compact()
+        old = self._ring_pos[slot]
+        if old >= 0:
+            self._ring_valid[old] = False  # slot reuse: void the old entry
+        pos = self._ring_n
+        self._ring[pos] = slot
+        self._ring_valid[pos] = True
+        self._ring_pos[slot] = pos
+        self._ring_n = pos + 1
+        created = self.meta["created"][slot]
+        if created < self._ring_last_created:
+            self._ring_unsorted = True
+        else:
+            self._ring_last_created = created
+
+    def _ring_compact(self):
+        """Drop invalidated/dead entries (and re-sort if flagged): runs
+        when the ring fills, amortized O(1) per add."""
+        n = self._ring_n
+        ring = self._ring[:n]
+        keep = self._ring_valid[:n] & self.store.alive[ring]
+        # Dropped entries must release their slots' back-pointers: a
+        # reused slot with a stale _ring_pos would invalidate whatever
+        # entry now occupies that position (a live slot's), permanently
+        # forcing the lexsort fallback.
+        self._ring_pos[ring[~keep]] = -1
+        live = ring[keep]
+        if self._ring_unsorted:
+            meta = self.meta
+            order = np.lexsort(
+                (meta["created_seq"][live], meta["created"][live])
+            )
+            live = live[order]
+            self._ring_unsorted = False
+        m = len(live)
+        if m == len(self._ring):  # live <= capacity < ring size, always
+            raise RuntimeError("slot ring compaction found no free space")
+        self._ring[:m] = live
+        self._ring_valid[:m] = True
+        self._ring_valid[m:] = False
+        self._ring_pos[live] = np.arange(m, dtype=np.int64)
+        self._ring_n = m
+        self._ring_last_created = (
+            self.meta["created"][live[-1]]
+            if m
+            else np.iinfo(np.int64).min
+        )
+
+    def _order_dispatch(self, device_slots, device_last):
+        """Order (device_slots, device_last) oldest-first by (created_at,
+        created_seq). Fast path reads the insertion ring; the lexsort
+        fallback covers unsorted rings and any ring/membership drift."""
+        ordered = None
+        if not self._ring_unsorted:
+            dm = self._dev_mask_scratch
+            dm[device_slots] = True
+            ring = self._ring[: self._ring_n]
+            keep = self._ring_valid[: self._ring_n] & dm[ring]
+            ordered = np.ascontiguousarray(ring[keep])
+            dm[device_slots] = False
+            if len(ordered) != len(device_slots):
+                ordered = None  # drift: resolve exactly
+        if ordered is None:
+            meta = self.meta
+            order = np.lexsort(
+                (
+                    meta["created_seq"][device_slots],
+                    meta["created"][device_slots],
+                )
+            )
+            ordered = np.ascontiguousarray(device_slots[order])
+            last = np.ascontiguousarray(device_last[order], dtype=np.uint8)
+            return ordered, last
+        # device_last is aligned to device_slots; realign to ring order
+        # via the last-interval recomputation the caller already encoded:
+        # map slot -> last flag, then gather in ring order.
+        lm = self._dev_mask_scratch
+        lm[device_slots] = device_last.astype(bool)
+        last = np.ascontiguousarray(lm[ordered], dtype=np.uint8)
+        lm[device_slots] = False
+        return ordered, last
 
     # ------------------------------------------------------------- dispatch
 
-    def _dispatch(self, slots: np.ndarray, rev: bool):
+    def _dispatch(self, slots: np.ndarray, last: np.ndarray, rev: bool):
         """Launch the device top-K for the given active slots; returns an
-        opaque pending handle whose transfer is already in flight."""
+        opaque pending handle whose transfer AND downstream host assembly
+        are already in flight on a worker thread."""
         hw = self.pool.high_water
         with_should = self._should_count > 0
         with_embedding = self._emb_count > 0
         if self._mesh is not None:
             return self._dispatch_sharded(
-                slots, rev, with_should, with_embedding
+                slots, last, rev, with_should, with_embedding
             )
         big = hw >= self.config.big_pool_threshold
 
@@ -735,6 +833,11 @@ class TpuBackend:
             )
 
             grid_lo, grid_inv = self._grid_params()
+            use_pairs = (
+                self.config.device_pairing
+                and not self.config.interval_pipelining
+                and self._nonpair_count == 0
+            )
             cand_dev = topk_candidates_big(
                 self.pool.device,
                 pad_to(slots, a_pad, -1),
@@ -743,6 +846,10 @@ class TpuBackend:
                 fn=self.fn,
                 fs=self.fs,
                 n_cols=n_cols,
+                # Pairs keep the full candidate width: coverage is set by
+                # list DIVERSITY, not handshake rounds — capping k to 16
+                # measured ~5% unmatched leftovers (overlapping lists
+                # exhaust under contention; rounds can't recover).
                 k=self.k,
                 rev=rev,
                 with_should=with_should,
@@ -751,8 +858,29 @@ class TpuBackend:
                 bn=bn,
                 interpret=self._interpret,
                 emb_scale=self.config.emb_score_scale,
+                # The handshake needs eligible candidates, not the exact
+                # (-score, created) order: skip stage 2's second sort.
+                order_exact=not use_pairs,
             )
-            return self._bg_fetch(cand_dev)
+            if use_pairs:
+                # Synchronous interval over a pure 1v1 pool: grouping runs
+                # on device (propose-accept handshake over the exact-ranked
+                # candidate lists) and only the partner vector crosses the
+                # D2H boundary — the candidate matrix (~16MB at 100k, the
+                # sync path's floor on any PCIe/tunnel) stays on device.
+                import jax.numpy as jnp
+
+                from .device2 import pair_partners
+
+                partner_dev, prop_dev = pair_partners(
+                    cand_dev,
+                    jnp.asarray(pad_to(slots, a_pad, -1)),
+                    cap=self.pool.capacity,
+                )
+                return self._bg_asm(
+                    "pairs", (partner_dev, prop_dev), slots, last, rev
+                )
+            return self._bg_asm("big", (cand_dev,), slots, last, rev)
 
         # Small-pool exact path (unchanged round-1 kernel).
         n_blocks = -(-len(slots) // self.row_block)
@@ -774,7 +902,7 @@ class TpuBackend:
             with_embedding=with_embedding,
             created_base=np.int32(self._created_base),
         )
-        return self._bg_fetch_small(scores, cand)
+        return self._bg_asm("small", (scores, cand), slots, last, rev)
 
     def _grid_params(self):
         """Bucket-grid (lo, 1/width) per numeric field for the big kernel."""
@@ -786,51 +914,178 @@ class TpuBackend:
         ).astype(np.float32)
         return grid_lo, grid_inv
 
-    def _bg_fetch(self, cand_dev):
-        """Pull the result to host on a worker thread: the D2H transfer
-        (and the wait for the async compute) runs during the gap to
-        the next interval, not on the interval critical path.
-        copy_to_host_async alone proved unreliable here — issued
-        before the computation commits, some plugins drop it and the
-        collect-side np.asarray pays the full transfer."""
+    def _bg_asm(self, kind, dev_arrays, slots, last, rev):
+        """Run the whole post-kernel tail on a worker thread: D2H fetch
+        (forced C-contiguous — this runtime hands back strided views whose
+        lazy gather measured 10-300ms), the exact candidate re-ordering
+        (small path), the native greedy assembly, and the host validation
+        of flagged matches. All of it rides the gap to the next interval;
+        collection picks up finished matches. ctypes drops the GIL for
+        the C assembly, and the numpy/C work here reads only per-slot
+        arrays whose staleness the accept step masks by gen/alive.
+        copy_to_host_async alone proved unreliable here — issued before
+        the computation commits, some plugins drop it and the collect-side
+        np.asarray pays the full transfer."""
         holder: dict = {}
+        n_rows = len(slots)
 
-        def _fetch(dev=cand_dev, out=holder):
+        def _run(out=holder):
             try:
-                # Force a real C-contiguous host ndarray HERE, in the
-                # gap: this runtime hands back a strided view, and the
-                # strided 16MB gather it implies was measured at
-                # 10-300ms when paid lazily inside the interval
-                # (ascontiguousarray at collect — the old code).
-                out["np"] = np.ascontiguousarray(np.asarray(dev))
+                if kind == "pairs":
+                    partner = np.ascontiguousarray(
+                        np.asarray(dev_arrays[0])
+                    )[:n_rows]
+                    proposer = np.ascontiguousarray(
+                        np.asarray(dev_arrays[1])
+                    )[:n_rows]
+                    out["asm"] = self._assemble_pairs(
+                        slots, partner, proposer, rev
+                    )
+                    return
+                if kind == "big":
+                    # Already exactly ordered by (-score, created) on
+                    # device; a row slice of the contiguous fetch stays
+                    # C-contiguous.
+                    cand_np = np.ascontiguousarray(
+                        np.asarray(dev_arrays[0])
+                    )[:n_rows]
+                else:
+                    scores_np = np.ascontiguousarray(
+                        np.asarray(dev_arrays[0])
+                    )[:n_rows]
+                    cand_np = np.ascontiguousarray(
+                        np.asarray(dev_arrays[1])
+                    )[:n_rows]
+                    cand_np = self._order_small(scores_np, cand_np)
+                out["asm"] = self._assemble(slots, last, cand_np, rev)
             except Exception as e:  # surfaced at collect
                 out["err"] = e
 
-        thread = threading.Thread(target=_fetch, daemon=True)
+        thread = threading.Thread(target=_run, daemon=True)
         thread.start()
-        return ("big", cand_dev, holder, thread)
+        return (kind, holder, thread)
 
-    def _bg_fetch_small(self, scores, cand):
-        """Small-path counterpart of _bg_fetch: both result arrays pull
-        to contiguous host memory in the gap (each synchronous
-        np.asarray on the tunneled runtime costs 10s of ms of fixed
-        latency that otherwise lands in the timed interval)."""
-        holder: dict = {}
+    def _assemble(self, slots, last, cand_np, rev):
+        """Native greedy assembly + host validation of flagged matches.
+        Exact query validation runs INSIDE the assembler (f64 mirrors):
+        an imprecision-admitted candidate is skipped there and assembly
+        continues with the next hit — matching the reference, whose index
+        search never returns non-matching hits. Only matches flagged
+        needs_host (host-only member under mutual validation) fall back
+        to the AST check."""
+        meta = self.meta
+        n_matches, offsets, flat, needs_host = native.assemble_arrays(
+            slots,
+            last,
+            cand_np,
+            min_count=meta["min_count"],
+            max_count=meta["max_count"],
+            count_multiple=meta["count_multiple"],
+            count=meta["count"],
+            intervals=meta["intervals"],
+            created=meta["created"],
+            session_hashes=meta["session_hashes"],
+            session_counts=meta["session_counts"],
+            exact=self.exact,
+            rev=rev,
+        )
+        ok = self._validate_flagged(n_matches, offsets, flat, needs_host, rev)
+        return n_matches, offsets, flat, ok
 
-        def _fetch(s=scores, c=cand, out=holder):
-            try:
-                out["scores"] = np.ascontiguousarray(np.asarray(s))
-                out["cand"] = np.ascontiguousarray(np.asarray(c))
-            except Exception as e:  # surfaced at collect
-                out["err"] = e
+    def _assemble_pairs(self, slots, partner, proposer, rev):
+        """Host tail of the device-pairing path: exact (f64) validation of
+        the device-formed pairs, vectorized over all pairs at once, then
+        the shared (n_matches, offsets, flat, ok) shape. Mirrors the
+        assembler's per-pair checks for the 1v1 case: forward query
+        acceptance (both directions under rev — reference validateMatch,
+        server/matchmaker.go:1042), session-overlap rejection. A pair
+        failing here is dropped (its members retry next interval) rather
+        than re-assembled — the f32/bucket false-positive rate this guards
+        is per-mille, and reference semantics permit unmatched leftovers."""
+        idx = np.nonzero(proposer & (partner >= 0))[0]
+        i_slots = slots[idx]
+        j_slots = partner[idx].astype(np.int32)
+        ok = self._exact_accepts_vec(i_slots, j_slots)
+        needs_host = np.zeros(len(idx), dtype=np.uint8)
+        if rev:
+            j_ok = self.exact["q_exact_ok"][j_slots]
+            back = self._exact_accepts_vec(j_slots, i_slots)
+            ok &= np.where(j_ok, back, True)
+            # Host-only passive member: its real query needs the AST check.
+            needs_host = (~j_ok).astype(np.uint8)
+        ok &= (
+            self.meta["session_hashes"][i_slots, 0]
+            != self.meta["session_hashes"][j_slots, 0]
+        )
+        i_slots, j_slots = i_slots[ok], j_slots[ok]
+        needs_host = needs_host[ok]
+        n = len(i_slots)
+        offsets = np.arange(0, 2 * n + 2, 2, dtype=np.int32)
+        flat = np.empty(2 * n, dtype=np.int32)
+        flat[0::2] = i_slots
+        flat[1::2] = j_slots
+        okv = self._validate_flagged(n, offsets, flat, needs_host, rev)
+        return n, offsets, flat, okv
 
-        thread = threading.Thread(target=_fetch, daemon=True)
-        thread.start()
-        return ("small_bg", scores, cand, holder, thread)
+    def _exact_accepts_vec(self, q, v):
+        """Vectorized mirror of the assembler's Exact::accepts (f64
+        mirrors, 63-bit hashes): does q's query accept v's values, for
+        slot arrays q, v elementwise."""
+        ex = self.exact
+        lo, hi = ex["q_lo"][q], ex["q_hi"][q]
+        x = ex["v_num"][v]
+        unconstrained = np.isinf(lo) & (lo < 0) & np.isinf(hi) & (hi > 0)
+        ok = np.all(unconstrained | ((x >= lo) & (x <= hi)), axis=1)
+        ok &= ~np.any(
+            (x >= ex["q_flo"][q]) & (x <= ex["q_fhi"][q]), axis=1
+        )
+        req, forb = ex["q_req"][q], ex["q_forb"][q]
+        sv = ex["v_str"][v]
+        ok &= np.all(
+            ((req == 0) | (sv == req)) & ((forb == 0) | (sv != forb)),
+            axis=1,
+        )
+        pure_should = ~ex["q_has_must"][q] & ex["q_has_should"][q]
+        if pure_should.any():
+            op, fld = ex["q_sh_op"][q], ex["q_sh_fld"][q]
+            fn = ex["v_num"].shape[1]
+            fs = ex["v_str"].shape[1]
+            r = np.arange(len(q))[:, None]
+            xv = x[r, np.minimum(fld, fn - 1)]
+            sv2 = sv[r, np.minimum(fld, fs - 1)]
+            term = ex["q_sh_term"][q]
+            hit = (
+                (
+                    (op == SOP_NUM_RANGE)
+                    & (xv >= ex["q_sh_lo"][q])
+                    & (xv <= ex["q_sh_hi"][q])
+                )
+                | ((op == SOP_STR_EQ) & (term != 0) & (sv2 == term))
+                | (op == SOP_ALL)
+            )
+            ok &= ~pure_should | np.any(hit, axis=1)
+        # Missing exact mirror (host-only query): not decidable here.
+        ok &= ex["q_exact_ok"][q]
+        return ok
+
+    def _order_small(self, scores_np, cand_np):
+        """Exact re-sort of each candidate list by (-score, created): the
+        small kernel's wait-time epsilon only biased the top-K cutoff."""
+        created_of = self.meta["created"][np.maximum(cand_np, 0)]
+        created_of = np.where(
+            cand_np < 0, np.iinfo(np.int64).max, created_of
+        )
+        by_created = np.argsort(created_of, axis=1, kind="stable")
+        s2 = np.take_along_axis(scores_np, by_created, axis=1)
+        by_score = np.argsort(-s2, axis=1, kind="stable")
+        order = np.take_along_axis(by_created, by_score, axis=1)
+        return np.ascontiguousarray(
+            np.take_along_axis(cand_np, order, axis=1)
+        )
 
     def _dispatch_sharded(
-        self, slots: np.ndarray, rev: bool, with_should: bool,
-        with_embedding: bool,
+        self, slots: np.ndarray, last: np.ndarray, rev: bool,
+        with_should: bool, with_embedding: bool,
     ):
         """Multi-device interval (SURVEY §2.8; parallel/mesh.py +
         device2.topk_candidates_big_sharded): every device scores all
@@ -866,7 +1121,7 @@ class TpuBackend:
                 interpret=self._interpret,
                 emb_scale=self.config.emb_score_scale,
             )
-            return self._bg_fetch(cand_dev)
+            return self._bg_asm("big", (cand_dev,), slots, last, rev)
 
         br = self.row_block
         n_blocks = -(-len(slots) // br)
@@ -887,7 +1142,7 @@ class TpuBackend:
             with_should=with_should,
             with_embedding=with_embedding,
         )
-        return self._bg_fetch_small(scores, cand)
+        return self._bg_asm("small", (scores, cand), slots, last, rev)
 
     def _prewarm_row_bucket(
         self, a_pad, n_cols, rev, with_should, with_embedding, bm, bn
@@ -939,37 +1194,15 @@ class TpuBackend:
 
         threading.Thread(target=_warm, daemon=True).start()
 
-    def _collect(self, pending, n_rows: int) -> np.ndarray:
-        """Materialize the pending device result into created/score-ordered
-        candidate slot lists [n_rows, k]."""
-        if pending[0] == "big":
-            # Already exactly ordered by (-score, created) on device.
-            _, _, holder, thread = pending
-            thread.join()
-            if "err" in holder:
-                raise holder["err"]
-            # The fetch thread materialized a real host ndarray; a row
-            # slice of it stays C-contiguous, so no interval-side copy.
-            return holder["np"][:n_rows]
-
-        # Small path: background-fetched like the big path (the fixed
-        # per-transfer latency of a synchronous np.asarray otherwise
-        # lands in the timed interval).
-        _, scores, cand, holder, thread = pending
+    def _collect(self, pending):
+        """Pick up the worker thread's finished (n_matches, offsets, flat,
+        ok) — free when the cohort was ready, a blocking join otherwise
+        (non-pipelined mode, or the block-drain fallback)."""
+        _, holder, thread = pending
         thread.join()
         if "err" in holder:
             raise holder["err"]
-        cand_np = holder["cand"][:n_rows]
-        scores_np = holder["scores"][:n_rows]
-        # Exact re-sort of each candidate list by (-score, created):
-        # the kernel's wait-time epsilon only biased the top-K cutoff.
-        created_of = self.meta["created"][np.maximum(cand_np, 0)]
-        created_of = np.where(cand_np < 0, np.iinfo(np.int64).max, created_of)
-        by_created = np.argsort(created_of, axis=1, kind="stable")
-        s2 = np.take_along_axis(scores_np, by_created, axis=1)
-        by_score = np.argsort(-s2, axis=1, kind="stable")
-        order = np.take_along_axis(by_created, by_score, axis=1)
-        return np.ascontiguousarray(np.take_along_axis(cand_np, order, axis=1))
+        return holder["asm"]
 
     # ----------------------------------------------------------- validation
 
