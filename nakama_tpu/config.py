@@ -110,6 +110,16 @@ class MatchmakerConfig:
     # cannot go stale; removed tickets are filtered at collection. Adds one
     # interval of matching latency; off by default.
     interval_pipelining: bool = False
+    # Device-side pair assignment: when intervals are synchronous
+    # (interval_pipelining off), the pool is large, and every live ticket
+    # is a solo 1v1 (min==max==2, count 1, multiple 1|2), grouping runs
+    # as a propose-accept handshake ON DEVICE (device2.pair_partners) and
+    # only the partner vector crosses D2H — the full candidate matrix
+    # (~16MB at 100k, the synchronous path's latency floor) never
+    # transfers. Matches stay exactly validated host-side; the matching
+    # is greedy-equivalent, not bit-identical to the sequential
+    # assembler's (oldest-first priority is preserved).
+    device_pairing: bool = True
     # Per-interval cap on host-only actives run through the CPU oracle
     # fallback (exotic queries the device kernel can't express). The
     # fallback is O(actives x pool) Python; without a cap a hostile or

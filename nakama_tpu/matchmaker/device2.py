@@ -334,7 +334,7 @@ def _stage1_call(
     jax.jit,
     static_argnames=(
         "fn", "fs", "n_cols", "k", "rev", "with_should", "with_embedding",
-        "bm", "bn", "interpret", "emb_scale",
+        "bm", "bn", "interpret", "emb_scale", "order_exact",
     ),
 )
 def topk_candidates_big(
@@ -354,6 +354,7 @@ def topk_candidates_big(
     bn: int = 1024,
     interpret: bool = False,
     emb_scale: float = 256.0,
+    order_exact: bool = True,
 ):
     """Two-stage top-k: returns slots i32 [A_pad, k] ordered by exact
     (-score, created), -1 padded. Drop-in contract of
@@ -419,6 +420,7 @@ def topk_candidates_big(
         rev=rev,
         with_should=with_should,
         with_embedding=with_embedding,
+        order_exact=order_exact,
     )
 
 
@@ -590,7 +592,7 @@ def topk_candidates_big_sharded(
 
 def _stage2(
     pool_n, rowq, active_slots, winners, *, k, rev, with_should,
-    with_embedding,
+    with_embedding, order_exact=True,
 ):
     """Exact re-rank of the per-block winners: [A_pad, B] packed → slots
     [A_pad, k] ordered by (-score, created)."""
@@ -673,6 +675,131 @@ def _stage2(
         (neg_prio, neg_score, created, slot), dimension=1, num_keys=1
     )
     s_k, c_k, slot_k = s_k[:, :k], c_k[:, :k], slot_k[:, :k]
+    if not order_exact:
+        # Pairs path: the handshake (pair_partners) needs eligible,
+        # compacted candidate lists, not the exact (-score, created)
+        # order — skip the second [A, k] multi-key sort.
+        return jnp.where(slot_k == 2**31 - 1, -1, slot_k)
     # Final exact order within the survivors: (-score, created).
     _, _, ordered = jax.lax.sort((s_k, c_k, slot_k), dimension=1, num_keys=3)
     return jnp.where(ordered == 2**31 - 1, -1, ordered)
+
+
+# -------------------------------------------------------- device pairing
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "rounds"))
+def pair_partners(
+    cand: jnp.ndarray,  # i32 [A, k] candidate slots, best-first, -1 pad
+    active_slots: jnp.ndarray,  # i32 [A] row slots, oldest-first, -1 pad
+    *,
+    cap: int,
+    rounds: int = 8,
+):
+    """Greedy 1v1 assignment entirely on device: parallel propose-accept
+    rounds over the exact-ranked candidate lists, oldest-first priority.
+
+    Replaces the synchronous path's candidate-matrix D2H (the latency
+    floor: [A,k] i32 is ~16MB at a 100k pool) with a partner vector
+    (~0.5MB). Semantics per round:
+
+    - every open row proposes to a still-available candidate — its
+      top-ranked one in round 0, pseudo-randomly diffused afterwards
+      (equal-score pools give every row the SAME candidate order, and
+      un-diffused proposals serialize to one pair per round);
+    - every proposed-to slot accepts its oldest proposer (min row index —
+      rows arrive sorted by (created_at, created_seq), the reference's
+      greedy iteration order, server/matchmaker_process.go:27);
+    - a won proposal forms a pair unless its target is a row whose own
+      proposal also won elsewhere (the target keeps its own win; the
+      proposer retries next round). Mutual top-choices tie-break to the
+      older row. Passive pool slots (inactive but matchable tickets) can
+      accept but never propose.
+
+    Built scatter-free where it counts: TPU scatters over ~100k random
+    indices measured ~8-10ms EACH (the first cut spent 1.17s in 24
+    rounds of them). Acceptance (per-slot min proposer) runs as a
+    sort + neighbor-compare + un-sort — two [A] sorts — and availability
+    updates batch into ONE fused scatter per round.
+
+    Returns (partner i32 [A] — formed-pair target slot on the PROPOSER
+    row, -1 elsewhere (each pair reports exactly once), proposer bool [A]
+    == partner >= 0, kept for call-site clarity).
+    """
+    a = cand.shape[0]
+    i32 = jnp.int32
+    rows = jnp.arange(a, dtype=i32)
+    big = jnp.int32(2**31 - 1)
+    valid_row = active_slots >= 0
+    slot_of_row = jnp.maximum(active_slots, 0)
+    # Pad rows (active_slots == -1) must not scatter: an index of
+    # slot_of_row=0 would clobber slot 0's real owner and let the same
+    # pair report from both sides (duplicate slots downstream).
+    row_of_slot = (
+        jnp.full((cap,), -1, i32)
+        .at[jnp.where(valid_row, slot_of_row, cap)]
+        .set(rows, mode="drop")
+    )
+    cand_safe = jnp.maximum(cand, 0)
+    # 2654435761 (Knuth) wrapped to int32 — jnp int32 math must not see a
+    # Python int above 2^31.
+    row_mix = (_mix(rows * jnp.int32(-1640531527) + 97) & 0x7FFFFFFF).astype(
+        i32
+    )
+
+    def round_fn(state, r):
+        avail_slot, partner = state
+        # A row is open while it neither formed a pair (partner set) nor
+        # had its own slot taken by an accepted proposal.
+        row_open = valid_row & (partner < 0) & avail_slot[slot_of_row]
+        cand_ok = (cand >= 0) & avail_slot[cand_safe] & row_open[:, None]
+        navail = jnp.sum(cand_ok, axis=1).astype(i32)
+        has = navail > 0
+        j = jnp.where(
+            has & (r > 0), (row_mix * r) % jnp.maximum(navail, 1), 0
+        )
+        csum = jnp.cumsum(cand_ok, axis=1)
+        first = jnp.argmax(csum == (j + 1)[:, None], axis=1)
+        prop = jnp.where(has, jnp.take_along_axis(
+            cand, first[:, None], axis=1)[:, 0], -1)
+        prop_safe = jnp.maximum(prop, 0)
+
+        # Acceptance: oldest proposer (min row index) per slot, one
+        # scatter-min + one gather. (A sort-based formulation was tried
+        # and measured SLOWER: two [A] lax.sorts cost more than one
+        # scatter on this chip.)
+        win = (
+            jnp.full((cap,), big, i32)
+            .at[jnp.where(prop >= 0, prop, cap + 1)]
+            .min(rows, mode="drop")
+        )
+        pwin = (prop >= 0) & (win[prop_safe] == rows)
+
+        trow = jnp.where(prop >= 0, row_of_slot[prop_safe], -1)
+        t_is_row = trow >= 0
+        t_safe = jnp.maximum(trow, 0)
+        t_pwin = pwin[t_safe] & t_is_row
+        t_prop = jnp.where(t_is_row, prop[t_safe], -1)
+        mutual = t_is_row & (t_prop == slot_of_row)
+        ok_t = (~t_is_row) | (~t_pwin) | (mutual & (rows < trow))
+        form = pwin & ok_t
+
+        partner = jnp.where(form, prop, partner)
+        # ONE fused availability scatter: both sides of every formed pair.
+        taken = jnp.concatenate(
+            [
+                jnp.where(form, slot_of_row, cap + 1),
+                jnp.where(form, prop_safe, cap + 1),
+            ]
+        )
+        avail_slot = avail_slot.at[taken].set(False, mode="drop")
+        return (avail_slot, partner), None
+
+    init = (
+        jnp.ones((cap,), dtype=bool),
+        jnp.full((a,), -1, i32),
+    )
+    (_, partner), _ = jax.lax.scan(
+        round_fn, init, jnp.arange(rounds, dtype=i32)
+    )
+    return partner, partner >= 0

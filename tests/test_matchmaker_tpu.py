@@ -398,3 +398,196 @@ def test_host_only_budget_defers_overflow():
         mm.process()
     total_entries = sum(len(s) for batch in got for s in batch)
     assert total_entries == 12  # every deferred ticket eventually matched
+
+
+# ------------------------------------------------------- device pairing
+
+
+def _pairing_mm(**kw):
+    """Synchronous big-path pool where device_pairing engages."""
+    defaults = dict(
+        big_pool_threshold=64,
+        interval_pipelining=False,
+        device_pairing=True,
+        candidates_per_ticket=128,  # complete lists: full pairing exists
+        max_intervals=2,
+    )
+    defaults.update(kw)
+    return make_tpu_mm(**defaults)
+
+
+def _fill_pairs(mm, n, modes=4):
+    users = []
+    for i in range(n):
+        m = i % modes
+        _, p = add(
+            mm,
+            f"properties.mode:m{m}",
+            strs={"mode": f"m{m}"},
+        )
+        users.append((p.user_id, m))
+    return dict(users)
+
+
+def test_device_pairing_runs_and_matches_validly():
+    mm, got = _pairing_mm()
+    calls = []
+    import nakama_tpu.matchmaker.device2 as d2
+
+    orig = d2.pair_partners
+    d2.pair_partners = lambda *a, **kw: calls.append(1) or orig(*a, **kw)
+    try:
+        mode_of = _fill_pairs(mm, 128)
+        assert mm.backend.pool.high_water >= 64
+        mm.process()
+    finally:
+        d2.pair_partners = orig
+    assert calls, "device pairing path did not run"
+    matched = 0
+    for batch in got:
+        for entry_set in batch:
+            assert len(entry_set) == 2
+            a, b = entry_set
+            # Exact validity: identical mode term both ways, distinct
+            # sessions.
+            assert mode_of[a.presence.user_id] == mode_of[b.presence.user_id]
+            assert a.presence.session_id != b.presence.session_id
+            matched += 2
+    # 128 tickets in 4 equal mode buckets of 32: a full pairing exists;
+    # the handshake must pair nearly everyone (leftovers retry, but with
+    # k=16 dense compatibility there should be none).
+    assert matched >= 120, matched
+
+
+def test_device_pairing_respects_incompatible_tickets():
+    mm, got = _pairing_mm()
+    # 65 tickets in one mode (odd count: exactly one leftover) + 3 in a
+    # lonely mode that can pair among themselves (one leftover each side).
+    for i in range(65):
+        add(mm, "properties.mode:x", strs={"mode": "x"})
+    for i in range(3):
+        add(mm, "properties.mode:y", strs={"mode": "y"})
+    mm.process()
+    for batch in got:
+        for es in batch:
+            m = {e.string_properties["mode"] for e in es}
+            assert len(m) == 1  # never cross-mode
+    # Leftovers: one x (odd), one y (odd) at most... 65+3 -> >= 66 matched
+    total = sum(len(es) for batch in got for es in batch)
+    assert total >= 64
+
+
+def test_device_pairing_disabled_for_nonpair_pools():
+    mm, got = _pairing_mm()
+    calls = []
+    import nakama_tpu.matchmaker.device2 as d2
+
+    orig = d2.pair_partners
+    d2.pair_partners = lambda *a, **kw: calls.append(1) or orig(*a, **kw)
+    try:
+        for i in range(70):
+            add(mm, "properties.mode:x", strs={"mode": "x"})
+        # One non-pair ticket (min 3) flips the pool off the pairing path.
+        add(mm, "properties.mode:x", mn=3, mx=3, strs={"mode": "x"})
+        mm.process()
+    finally:
+        d2.pair_partners = orig
+    assert not calls
+    assert sum(len(es) for b in got for es in b) >= 68
+
+
+def test_device_pairing_parity_with_oracle_validity():
+    # Same pool through the CPU oracle and the pairing path: the pairing
+    # match SET need not be identical (parallel greedy vs sequential) but
+    # every match must be one the oracle's rules accept, and the matched
+    # coverage must not regress.
+    specs = [("m%d" % (i % 3), i) for i in range(90)]
+    cfg = MatchmakerConfig(max_intervals=2, backend="cpu")
+    from nakama_tpu.matchmaker.local import CpuBackend
+
+    cpu_mm = LocalMatchmaker(quiet_logger(), cfg, backend=CpuBackend())
+    cpu_got = []
+    cpu_mm.on_matched = cpu_got.append
+    for m, i in specs:
+        p = presence()
+        cpu_mm.add(
+            [p], p.session_id, "", f"properties.mode:{m}", 2, 2, 1,
+            {"mode": m}, {},
+        )
+    cpu_mm.process()
+    cpu_total = sum(len(es) for b in cpu_got for es in b)
+
+    mm, got = _pairing_mm()
+    for m, i in specs:
+        p = presence()
+        mm.add(
+            [p], p.session_id, "", f"properties.mode:{m}", 2, 2, 1,
+            {"mode": m}, {},
+        )
+    mm.process()
+    tpu_total = sum(len(es) for b in got for es in b)
+    assert tpu_total >= cpu_total - 2, (tpu_total, cpu_total)
+
+
+def test_pair_partners_pad_rows_do_not_clobber_slot0():
+    # Regression (round-4 review): pad rows (active_slots == -1) used a
+    # clamped scatter index of 0, overwriting slot 0's row mapping with
+    # -1; the pairing path then reported the same pair from both sides
+    # (duplicate slots -> double-free downstream).
+    import jax.numpy as jnp
+
+    from nakama_tpu.matchmaker.device2 import pair_partners
+
+    cand = jnp.asarray(
+        [[1, -1], [0, -1], [0, -1], [-1, -1]], dtype=jnp.int32
+    )
+    active = jnp.asarray([0, 1, 2, -1], dtype=jnp.int32)
+    partner, proposer = pair_partners(cand, active, cap=8, rounds=4)
+    partner = np.asarray(partner)
+    proposer = np.asarray(proposer)
+    pairs = {
+        tuple(sorted((int(active[i]), int(partner[i]))))
+        for i in np.nonzero(proposer)[0]
+    }
+    # Exactly one pair may claim slot 0; each pair reported once.
+    assert len(pairs) == int(proposer.sum())
+    flat = [s for p in pairs for s in p]
+    assert len(flat) == len(set(flat))
+
+
+def test_store_duplicate_id_readd_after_lazy_remove():
+    # Regression (round-4 review): re-adding a ticket id that is still
+    # in the undrained graveyard triggered the drain-retry path, which
+    # retried with the PRE-drain slot and left the allocated slot on the
+    # free list — the next add then popped an occupied slot.
+    mm, got = make_tpu_mm()
+    t1, p1 = add(mm, "properties.mode:q", strs={"mode": "q"})
+    t2, p2 = add(mm, "properties.mode:q", strs={"mode": "q"})
+    mm.process()  # both matched -> lazy (deferred) removal, no drain yet
+    assert sum(len(es) for b in got for es in b) == 2
+    # Re-add tickets with the SAME ids via insert (handover redelivery).
+    from nakama_tpu.matchmaker.types import MatchmakerExtract
+
+    mm.insert(
+        [
+            MatchmakerExtract(
+                presences=[p1],
+                session_id=p1.session_id,
+                party_id="",
+                query="properties.mode:q",
+                min_count=2,
+                max_count=2,
+                count_multiple=1,
+                string_properties={"mode": "q"},
+                numeric_properties={},
+                ticket=t1,
+                created_at=1.0,
+                intervals=0,
+            )
+        ]
+    )
+    assert t1 in mm.tickets
+    # Allocator must stay consistent: a burst of fresh adds succeeds.
+    for _ in range(8):
+        add(mm, "properties.mode:z", strs={"mode": "z"})
+    assert len(mm) == 1 + 8

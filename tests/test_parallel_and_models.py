@@ -265,6 +265,10 @@ def test_full_process_on_mesh_big_kernel_matches_single_device():
             max_constraints=8,
             mesh_devices=mesh_devices,
             big_pool_threshold=64,  # force the MXU path at test scale
+            # Exact assembler parity is what this test proves; the
+            # device-pairing fast path (sync pure-1v1 pools) is covered
+            # by its own tests in test_matchmaker_tpu.py.
+            device_pairing=False,
         )
         backend = TpuBackend(
             cfg, quiet_logger(), row_block=16, col_block=64,
