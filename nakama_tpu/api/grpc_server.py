@@ -157,6 +157,39 @@ ROUTES: dict[str, RouteSpec] = {
         P.DeleteLeaderboardRecordRequest, P.Empty, body=None,
         path_fields=("leaderboard_id",),
     ),
+    "ListLeaderboardRecordsAroundOwner": RouteSpec(
+        "GET",
+        lambda d: (
+            f"/v2/leaderboard/{d.get('leaderboard_id', '')}"
+            f"/owner/{d.get('owner_id', '')}"
+        ),
+        P.ListLeaderboardRecordsAroundOwnerRequest, P.LeaderboardRecordList,
+        body="query", path_fields=("leaderboard_id", "owner_id"),
+    ),
+    "ListTournamentRecordsAroundOwner": RouteSpec(
+        "GET",
+        lambda d: (
+            f"/v2/tournament/{d.get('tournament_id', '')}"
+            f"/owner/{d.get('owner_id', '')}"
+        ),
+        P.ListTournamentRecordsAroundOwnerRequest, P.LeaderboardRecordList,
+        body="query", path_fields=("tournament_id", "owner_id"),
+    ),
+    "DeleteTournamentRecord": RouteSpec(
+        "DELETE", lambda d: f"/v2/tournament/{d.get('tournament_id', '')}",
+        P.DeleteTournamentRecordRequest, P.Empty, body=None,
+        path_fields=("tournament_id",),
+    ),
+    "ListChannelMessages": RouteSpec(
+        "GET", lambda d: f"/v2/channel/{d.get('channel_id', '')}",
+        P.ListChannelMessagesRequest, P.ChannelMessageList,
+        body="query", path_fields=("channel_id",),
+    ),
+    "UpdateGroup": RouteSpec(
+        "PUT", lambda d: f"/v2/group/{d.get('group_id', '')}",
+        P.UpdateGroupRequest, P.Empty,
+        path_fields=("group_id",),
+    ),
     "ListTournaments": RouteSpec(
         "GET", "/v2/tournament", P.ListTournamentsRequest,
         P.TournamentList, body="query",

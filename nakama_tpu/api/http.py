@@ -169,6 +169,7 @@ class ApiServer:
         r.add_get("/v2/tournament/{id}", self._h_t_records_list)
         r.add_post("/v2/tournament/{id}", self._h_t_record_write)
         r.add_post("/v2/tournament/{id}/join", self._h_t_join)
+        r.add_delete("/v2/tournament/{id}", self._h_t_record_delete)
         r.add_get(
             "/v2/tournament/{id}/owner/{owner_id}", self._h_lb_haystack
         )
@@ -1355,6 +1356,21 @@ class ApiServer:
                 ),
             )
             return web.json_response(result)
+        except Exception as e:
+            return self._map_error(e)
+
+    async def _h_t_record_delete(self, request: web.Request):
+        """Reference DeleteTournamentRecord (apigrpc.proto:300): the
+        caller deletes their own current-window record; authoritative
+        tournaments reject client deletes (core_tournament.go:661)."""
+        try:
+            claims = self._session(request)
+            await self.server.tournaments.record_delete(
+                request.match_info["id"],
+                claims.user_id,
+                caller_authoritative=False,
+            )
+            return web.json_response({})
         except Exception as e:
             return self._map_error(e)
 

@@ -194,6 +194,52 @@ class Tournaments:
         self._get(id)
         return await self.lb.records_list(id, **kw)
 
+    async def records_haystack(self, id: str, owner_id: str, **kw) -> dict:
+        """Around-owner window on a tournament (reference
+        TournamentRecordsHaystack, core_tournament.go:687)."""
+        self._get(id)
+        return await self.lb.records_haystack(id, owner_id, **kw)
+
+    async def add_attempt(self, id: str, owner_id: str, count: int):
+        """Grant extra score attempts to one owner by raising the
+        per-record max_num_score override (reference TournamentAddAttempt,
+        core_tournament.go; record_write prefers the record's own limit)."""
+        t = self._get(id)
+        expiry = t.expiry_at(time.time())
+        row = await self.db.fetch_one(
+            "SELECT num_score, max_num_score FROM leaderboard_record"
+            " WHERE leaderboard_id = ? AND expiry_time = ? AND owner_id = ?",
+            (id, expiry, owner_id),
+        )
+        if row is None:
+            raise TournamentError("tournament record not found", "not_found")
+        base = row["max_num_score"] or t.max_num_score
+        await self.db.execute(
+            "UPDATE leaderboard_record SET max_num_score = ?"
+            " WHERE leaderboard_id = ? AND expiry_time = ? AND owner_id = ?",
+            (max(1, base + int(count)), id, expiry, owner_id),
+        )
+
+    async def record_delete(
+        self, id: str, owner_id: str, caller_authoritative: bool = False
+    ):
+        """Delete the owner's record in the current window (reference
+        TournamentRecordDelete, core_tournament.go:661: clients may
+        delete their own record unless the tournament is authoritative)."""
+        t = self._get(id)
+        if t.authoritative and not caller_authoritative:
+            raise TournamentError(
+                "tournament records can only be deleted by the server",
+                "permission_denied",
+            )
+        expiry = t.expiry_at(time.time())
+        await self.db.execute(
+            "DELETE FROM leaderboard_record WHERE leaderboard_id = ?"
+            " AND expiry_time = ? AND owner_id = ?",
+            (id, expiry, owner_id),
+        )
+        self.lb.ranks.delete(id, expiry, owner_id)
+
     # --------------------------------------------------------------- list
 
     def list(
