@@ -207,3 +207,35 @@ def _row_to_user(row: dict) -> dict:
         "steam_id": row["steam_id"] or "",
         "apple_id": row["apple_id"] or "",
     }
+
+
+async def ban_users(db: Database, user_ids: list[str]) -> None:
+    """Set disable_time so every auth path rejects the account (reference
+    BanUsers, core_user.go; callers also ban the session cache + disconnect
+    live sessions — see nk.users_ban_id)."""
+    import time as _time
+
+    now = _time.time()
+    for uid in user_ids:
+        await db.execute(
+            "UPDATE users SET disable_time = ? WHERE id = ?", (now, uid)
+        )
+
+
+async def unban_users(db: Database, user_ids: list[str]) -> None:
+    """Clear disable_time (reference UnbanUsers, core_user.go)."""
+    for uid in user_ids:
+        await db.execute(
+            "UPDATE users SET disable_time = 0 WHERE id = ?", (uid,)
+        )
+
+
+async def users_get_random(db: Database, count: int) -> list[dict]:
+    """Random user sample (reference UsersGetRandom, core_user.go:
+    TABLESAMPLE equivalent — SQLite random ordering at these counts)."""
+    rows = await db.fetch_all(
+        "SELECT * FROM users WHERE disable_time = 0"
+        " ORDER BY RANDOM() LIMIT ?",
+        (max(0, min(int(count), 1000)),),
+    )
+    return [_row_to_user(r) for r in rows]

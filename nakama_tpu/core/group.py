@@ -126,6 +126,16 @@ class Groups:
         async with self.db.tx() as tx:
             return self._row_to_group(await self._group(tx, group_id))
 
+    async def get_random(self, count: int) -> list[dict]:
+        """Random open-group sample (reference GroupsGetRandom,
+        core_group.go)."""
+        rows = await self.db.fetch_all(
+            "SELECT * FROM groups WHERE disable_time = 0"
+            " ORDER BY RANDOM() LIMIT ?",
+            (max(0, min(int(count), 1000)),),
+        )
+        return [self._row_to_group(r) for r in rows]
+
     async def get_many(self, group_ids: list[str]) -> list[dict]:
         out = []
         for gid in group_ids:
@@ -404,7 +414,7 @@ class Groups:
 
     async def list(
         self, name: str | None = None, limit: int = 100, cursor: str = "",
-        open: bool | None = None,
+        open: bool | None = None, lang_tag: str | None = None,
     ) -> dict:
         """Browse/search groups (reference ListGroups; name supports a
         trailing-% prefix search like the reference's ILIKE)."""
@@ -418,6 +428,9 @@ class Groups:
         if open is not None:
             where += " AND state = ?"
             params.append(0 if open else 1)
+        if lang_tag:
+            where += " AND lang_tag = ?"
+            params.append(lang_tag)
         rows = await self.db.fetch_all(
             f"SELECT * FROM groups {where} ORDER BY name LIMIT ? OFFSET ?",
             (*params, limit + 1, offset),

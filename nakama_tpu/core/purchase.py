@@ -162,6 +162,65 @@ class Purchases:
             "cursor": str(offset + limit) if has_more else "",
         }
 
+    async def list_purchases(
+        self, user_id: str = "", limit: int = 100, cursor: str = ""
+    ) -> dict:
+        """Validated-purchase listing, per user or store-wide (reference
+        nk.PurchasesList runtime_go_nakama.go; console ListPurchases)."""
+        limit = max(1, min(int(limit), 100))
+        offset = int(cursor) if cursor else 0
+        where, params = "", []
+        if user_id:
+            where = "WHERE user_id = ?"
+            params.append(user_id)
+        rows = await self.db.fetch_all(
+            f"SELECT * FROM purchase {where}"
+            " ORDER BY purchase_time DESC, transaction_id DESC"
+            " LIMIT ? OFFSET ?",
+            (*params, limit + 1, offset),
+        )
+        has_more = len(rows) > limit
+        rows = rows[:limit]
+        return {
+            "validated_purchases": [
+                {
+                    "user_id": r["user_id"],
+                    "transaction_id": r["transaction_id"],
+                    "product_id": r["product_id"],
+                    "store": r["store"],
+                    "purchase_time": r["purchase_time"],
+                    "refund_time": r["refund_time"],
+                    "environment": r["environment"],
+                }
+                for r in rows
+            ],
+            "cursor": str(offset + limit) if has_more else "",
+        }
+
+    async def get_subscription_by_product(
+        self, user_id: str, product_id: str
+    ) -> dict | None:
+        """Reference nk.SubscriptionGetByProductId."""
+        r = await self.db.fetch_one(
+            "SELECT * FROM subscription WHERE user_id = ?"
+            " AND product_id = ?",
+            (user_id, product_id),
+        )
+        if r is None:
+            return None
+        import time as _time
+
+        return {
+            "user_id": r["user_id"],
+            "original_transaction_id": r["original_transaction_id"],
+            "product_id": r["product_id"],
+            "store": r["store"],
+            "purchase_time": r["purchase_time"],
+            "expire_time": r["expire_time"],
+            "active": r["expire_time"] > _time.time(),
+            "environment": r["environment"],
+        }
+
     async def get_by_transaction(self, transaction_id: str) -> dict | None:
         r = await self.db.fetch_one(
             "SELECT * FROM purchase WHERE transaction_id = ?",

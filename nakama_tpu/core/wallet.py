@@ -116,6 +116,31 @@ class Wallets:
             )
         return results
 
+    async def ledger_update(self, ledger_id: str, metadata: dict) -> dict:
+        """Replace a ledger item's metadata (reference
+        WalletLedgerUpdate, core_wallet.go)."""
+        import time as _time
+
+        row = await self.db.fetch_one(
+            "SELECT * FROM wallet_ledger WHERE id = ?", (ledger_id,)
+        )
+        if row is None:
+            raise WalletError("ledger item not found", "not_found")
+        now = _time.time()
+        await self.db.execute(
+            "UPDATE wallet_ledger SET metadata = ?, update_time = ?"
+            " WHERE id = ?",
+            (json.dumps(metadata), now, ledger_id),
+        )
+        return {
+            "id": row["id"],
+            "user_id": row["user_id"],
+            "changeset": json.loads(row["changeset"]),
+            "metadata": metadata,
+            "create_time": row["create_time"],
+            "update_time": now,
+        }
+
     async def list_ledger(
         self, user_id: str, limit: int = 100, cursor: str = ""
     ) -> tuple[list[dict], str]:

@@ -301,7 +301,10 @@ class Leaderboards:
                             (score, subscore), cur
                         )
                 rank_changed = (new_score, new_sub) != cur
-            limit = max_num_score or lb.max_num_score
+            # Per-record override first (TournamentAddAttempt writes it),
+            # then the caller's, then the board default.
+            row_max = row["max_num_score"] if row else 0
+            limit = row_max or max_num_score or lb.max_num_score
             if limit and row is not None and row["num_score"] >= limit:
                 raise LeaderboardError(
                     "maximum number of score attempts reached",

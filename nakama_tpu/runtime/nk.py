@@ -54,6 +54,7 @@ class NakamaModule:
         channels=None,
         leaderboards=None,
         tournaments=None,
+        purchases=None,
         runtime=None,
     ):
         self.logger = logger.with_fields(subsystem="nk")
@@ -78,6 +79,7 @@ class NakamaModule:
         self.channels = channels
         self.leaderboards = leaderboards
         self.tournaments = tournaments
+        self.purchases = purchases
         self.runtime = runtime
 
     # ------------------------------------------------------------- helpers
@@ -138,6 +140,95 @@ class NakamaModule:
             )
         return token, claims.expires_at
 
+    # Social-provider auth (each core verifies with the social client the
+    # way the API layer does — reference runtime_go_nakama.go
+    # AuthenticateApple..AuthenticateSteam).
+
+    def _social(self):
+        if self.social is None:
+            raise RuntimeError("social client not configured")
+        return self.social
+
+    async def authenticate_apple(
+        self, token: str, username: str = "", create: bool = True
+    ):
+        return await core_auth.authenticate_apple(
+            self._db(), self._social(), self.config.social.apple_bundle_id,
+            token, username or None, create,
+        )
+
+    async def authenticate_facebook(
+        self, token: str, username: str = "", create: bool = True,
+        import_friends: bool = False,
+    ):
+        return await core_auth.authenticate_facebook(
+            self._db(), self._social(), token, username or None, create
+        )
+
+    async def authenticate_facebook_instant_game(
+        self, signed_player_info: str, username: str = "",
+        create: bool = True,
+    ):
+        return await core_auth.authenticate_facebook_instant(
+            self._db(), self._social(),
+            self.config.social.facebook_instant_app_secret,
+            signed_player_info, username or None, create,
+        )
+
+    async def authenticate_game_center(
+        self, player_id: str, bundle_id: str, timestamp: int, salt: str,
+        signature: str, public_key_url: str, username: str = "",
+        create: bool = True,
+    ):
+        return await core_auth.authenticate_gamecenter(
+            self._db(), self._social(), player_id, bundle_id, timestamp,
+            salt, signature, public_key_url, username or None, create,
+        )
+
+    async def authenticate_google(
+        self, token: str, username: str = "", create: bool = True
+    ):
+        return await core_auth.authenticate_google(
+            self._db(), self._social(), token, username or None, create
+        )
+
+    async def authenticate_steam(
+        self, token: str, username: str = "", create: bool = True
+    ):
+        sc = self.config.social
+        return await core_auth.authenticate_steam(
+            self._db(), self._social(), sc.steam_app_id,
+            sc.steam_publisher_key, token, username or None, create,
+        )
+
+    def session_logout(
+        self, user_id: str, token: str = "", refresh_token: str = ""
+    ) -> None:
+        """Invalidate a user's session tokens (reference SessionLogout,
+        runtime_go_nakama.go): specific tokens when given, else all."""
+        cache = self._component("session_cache")
+        from ..api import session_token
+
+        key = self.config.session.encryption_key
+        if not token and not refresh_token:
+            cache.remove_all(user_id)
+            return
+        if token:
+            claims = session_token.parse(key, token)
+            cache.remove_session(user_id, claims.token_id)
+        if refresh_token:
+            claims = session_token.parse(
+                self.config.session.refresh_encryption_key, refresh_token
+            )
+            cache.remove_refresh(user_id, claims.token_id)
+
+    async def session_disconnect(
+        self, session_id: str, reason: str = ""
+    ) -> bool:
+        return await self._component("session_registry").disconnect(
+            session_id, reason
+        )
+
     # ------------------------------------------------------------ accounts
 
     async def account_get_id(self, user_id: str) -> dict:
@@ -160,11 +251,38 @@ class NakamaModule:
     ) -> None:
         await core_account.delete_account(self._db(), user_id, recorded)
 
+    async def account_export_id(self, user_id: str) -> str:
+        """One JSON document of everything held for a user (reference
+        AccountExportId)."""
+        return json.dumps(
+            await core_account.export_account(self._db(), user_id)
+        )
+
     async def users_get_id(self, user_ids: list[str]) -> list[dict]:
         return await core_account.get_users(self._db(), user_ids=user_ids)
 
     async def users_get_username(self, usernames: list[str]) -> list[dict]:
         return await core_account.get_users(self._db(), usernames=usernames)
+
+    async def users_get_random(self, count: int) -> list[dict]:
+        return await core_account.users_get_random(self._db(), count)
+
+    async def users_ban_id(self, user_ids: list[str]) -> None:
+        """Ban: disable accounts, invalidate cached sessions, disconnect
+        live sockets (reference UsersBanId, runtime_go_nakama.go)."""
+        await core_account.ban_users(self._db(), user_ids)
+        if self.session_cache is not None:
+            self.session_cache.ban(user_ids)
+        if self.session_registry is not None:
+            targets = set(user_ids)
+            for s in self.session_registry.all():
+                if s.user_id in targets:
+                    await s.close("banned")
+
+    async def users_unban_id(self, user_ids: list[str]) -> None:
+        await core_account.unban_users(self._db(), user_ids)
+        if self.session_cache is not None:
+            self.session_cache.unban(user_ids)
 
     # ------------------------------------------------------------- linking
 
@@ -185,6 +303,68 @@ class NakamaModule:
 
     async def unlink_custom(self, user_id: str):
         await core_link.unlink_custom(self._db(), user_id)
+
+    async def link_apple(self, user_id: str, token: str):
+        await core_link.link_apple(
+            self._db(), self._social(), user_id,
+            self.config.social.apple_bundle_id, token,
+        )
+
+    async def unlink_apple(self, user_id: str):
+        await core_link.unlink_apple(self._db(), user_id)
+
+    async def link_facebook(
+        self, user_id: str, username: str, token: str,
+        import_friends: bool = False,
+    ):
+        await core_link.link_facebook(
+            self._db(), self._social(), user_id, token
+        )
+
+    async def unlink_facebook(self, user_id: str):
+        await core_link.unlink_facebook(self._db(), user_id)
+
+    async def link_facebook_instant_game(
+        self, user_id: str, signed_player_info: str
+    ):
+        await core_link.link_facebook_instant(
+            self._db(), self._social(), user_id,
+            self.config.social.facebook_instant_app_secret,
+            signed_player_info,
+        )
+
+    async def unlink_facebook_instant_game(self, user_id: str):
+        await core_link.unlink_facebook_instant(self._db(), user_id)
+
+    async def link_game_center(
+        self, user_id: str, player_id: str, bundle_id: str, timestamp: int,
+        salt: str, signature: str, public_key_url: str,
+    ):
+        await core_link.link_gamecenter(
+            self._db(), self._social(), user_id, player_id, bundle_id,
+            timestamp, salt, signature, public_key_url,
+        )
+
+    async def unlink_game_center(self, user_id: str):
+        await core_link.unlink_gamecenter(self._db(), user_id)
+
+    async def link_google(self, user_id: str, token: str):
+        await core_link.link_google(
+            self._db(), self._social(), user_id, token
+        )
+
+    async def unlink_google(self, user_id: str):
+        await core_link.unlink_google(self._db(), user_id)
+
+    async def link_steam(self, user_id: str, username: str, token: str):
+        sc = self.config.social
+        await core_link.link_steam(
+            self._db(), self._social(), user_id, sc.steam_app_id,
+            sc.steam_publisher_key, token,
+        )
+
+    async def unlink_steam(self, user_id: str):
+        await core_link.unlink_steam(self._db(), user_id)
 
     # ------------------------------------------------------------- storage
 
@@ -290,6 +470,12 @@ class NakamaModule:
         w = self._component("wallet")
         return await w.list_ledger(user_id, limit, cursor)
 
+    async def wallet_ledger_update(
+        self, ledger_id: str, metadata: dict
+    ) -> dict:
+        w = self._component("wallet")
+        return await w.ledger_update(ledger_id, metadata)
+
     async def multi_update(
         self,
         wallet_updates: list[dict] | None = None,
@@ -356,6 +542,69 @@ class NakamaModule:
             subject=subject, content=content, code=code, persistent=persistent
         )
 
+    async def notifications_delete(
+        self, user_id: str, ids: list[str]
+    ) -> None:
+        n = self._component("notifications")
+        await n.delete(user_id, ids)
+
+    # ----------------------------------------------- purchases/subscriptions
+
+    async def purchase_validate_apple(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> list[dict]:
+        p = self._component("purchases")
+        return await p.validate_apple(user_id, receipt, persist)
+
+    async def purchase_validate_google(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> list[dict]:
+        p = self._component("purchases")
+        return await p.validate_google(user_id, receipt, persist)
+
+    async def purchase_validate_huawei(
+        self, user_id: str, receipt: str, signature: str = "",
+        persist: bool = True,
+    ) -> list[dict]:
+        p = self._component("purchases")
+        return await p.validate_huawei(user_id, receipt, persist)
+
+    async def purchase_get_by_transaction_id(
+        self, transaction_id: str
+    ) -> dict | None:
+        p = self._component("purchases")
+        return await p.get_by_transaction(transaction_id)
+
+    async def purchases_list(
+        self, user_id: str = "", limit: int = 100, cursor: str = ""
+    ) -> dict:
+        p = self._component("purchases")
+        return await p.list_purchases(user_id, limit, cursor)
+
+    async def subscription_validate_apple(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> dict:
+        p = self._component("purchases")
+        return await p.validate_subscription_apple(user_id, receipt, persist)
+
+    async def subscription_validate_google(
+        self, user_id: str, receipt: str, persist: bool = True
+    ) -> dict:
+        p = self._component("purchases")
+        return await p.validate_subscription_google(user_id, receipt, persist)
+
+    async def subscription_get_by_product_id(
+        self, user_id: str, product_id: str
+    ) -> dict | None:
+        p = self._component("purchases")
+        return await p.get_subscription_by_product(user_id, product_id)
+
+    async def subscriptions_list(
+        self, user_id: str, limit: int = 100, cursor: str = ""
+    ) -> dict:
+        p = self._component("purchases")
+        return await p.list_subscriptions(user_id, limit, cursor)
+
     # ------------------------------------------------------------- streams
 
     def _stream(self, stream: dict) -> Stream:
@@ -410,6 +659,47 @@ class NakamaModule:
     def stream_count(self, stream: dict) -> int:
         tracker = self._component("tracker")
         return len(tracker.list_by_stream(self._stream(stream)))
+
+    def stream_user_get(
+        self, stream: dict, user_id: str, session_id: str
+    ) -> dict | None:
+        """Presence meta for one user on a stream (reference
+        StreamUserGet)."""
+        tracker = self._component("tracker")
+        p = tracker.get_by_stream_user(self._stream(stream), session_id)
+        if p is None or p.user_id != user_id:
+            return None
+        return p.as_dict()
+
+    def stream_user_update(
+        self, stream: dict, user_id: str, session_id: str,
+        hidden: bool = False, persistence: bool = True,
+    ) -> bool:
+        sm = self._component("stream_manager")
+        return sm.user_update(
+            self._stream(stream), user_id, session_id, hidden, persistence
+        )
+
+    def stream_user_kick(
+        self, stream: dict, user_id: str, session_id: str
+    ) -> None:
+        """Force one presence off a stream (reference StreamUserKick —
+        identical effect to a server-side leave)."""
+        sm = self._component("stream_manager")
+        sm.user_leave(self._stream(stream), user_id, session_id)
+
+    def stream_close(self, stream: dict) -> None:
+        """Untrack every presence on the stream (reference StreamClose)."""
+        tracker = self._component("tracker")
+        s = self._stream(stream)
+        for p in list(tracker.list_by_stream(s)):
+            tracker.untrack(p.session_id, s)
+
+    def stream_send_raw(self, stream: dict, envelope: dict) -> None:
+        """Deliver a raw rtapi envelope dict to a stream (reference
+        StreamSendRaw — the caller owns the envelope shape)."""
+        router = self._component("router")
+        router.send_to_stream(self._stream(stream), envelope)
 
     # ------------------------------------------------------------- matches
 
@@ -472,6 +762,30 @@ class NakamaModule:
         lb = self._component("leaderboards")
         return await lb.records_list(id, **kwargs)
 
+    def leaderboard_list(
+        self, categories: list[int] | None = None
+    ) -> list[dict]:
+        lb = self._component("leaderboards")
+        return [
+            b.as_dict() for b in lb.list(categories=categories)
+            if not b.is_tournament
+        ]
+
+    def leaderboards_get_id(self, ids: list[str]) -> list[dict]:
+        lb = self._component("leaderboards")
+        out = []
+        for i in ids:
+            b = lb.get(i)
+            if b is not None and not b.is_tournament:
+                out.append(b.as_dict())
+        return out
+
+    async def leaderboard_records_haystack(
+        self, id: str, owner_id: str, limit: int = 100, **kwargs
+    ) -> dict:
+        lb = self._component("leaderboards")
+        return await lb.records_haystack(id, owner_id, limit=limit, **kwargs)
+
     async def leaderboard_record_delete(self, id: str, owner_id: str):
         lb = self._component("leaderboards")
         await lb.record_delete(id, owner_id)
@@ -498,6 +812,37 @@ class NakamaModule:
         return await t.record_write(
             id, owner_id, username, score, subscore, metadata
         )
+
+    def tournament_list(
+        self, categories: list[int] | None = None, active_only: bool = False
+    ) -> list[dict]:
+        t = self._component("tournaments")
+        return t.list(categories=categories, active_only=active_only)
+
+    def tournaments_get_id(self, ids: list[str]) -> list[dict]:
+        t = self._component("tournaments")
+        wanted = set(ids)
+        return [d for d in t.list() if d["id"] in wanted]
+
+    async def tournament_records_list(self, id: str, **kwargs) -> dict:
+        t = self._component("tournaments")
+        return await t.records_list(id, **kwargs)
+
+    async def tournament_records_haystack(
+        self, id: str, owner_id: str, limit: int = 100, **kwargs
+    ) -> dict:
+        t = self._component("tournaments")
+        return await t.records_haystack(id, owner_id, limit=limit, **kwargs)
+
+    async def tournament_record_delete(self, id: str, owner_id: str):
+        t = self._component("tournaments")
+        await t.record_delete(id, owner_id, caller_authoritative=True)
+
+    async def tournament_add_attempt(
+        self, id: str, owner_id: str, count: int
+    ):
+        t = self._component("tournaments")
+        await t.add_attempt(id, owner_id, count)
 
     # ------------------------------------------------------ friends/groups
 
@@ -556,6 +901,50 @@ class NakamaModule:
         g = self._component("groups")
         await g.users_kick(group_id, user_ids, caller_id)
 
+    async def group_users_ban(
+        self, group_id: str, user_ids: list[str], caller_id: str = ""
+    ):
+        g = self._component("groups")
+        await g.users_ban(group_id, user_ids, caller_id)
+
+    async def group_users_promote(
+        self, group_id: str, user_ids: list[str], caller_id: str = ""
+    ):
+        g = self._component("groups")
+        await g.users_promote(group_id, user_ids, caller_id)
+
+    async def group_users_demote(
+        self, group_id: str, user_ids: list[str], caller_id: str = ""
+    ):
+        g = self._component("groups")
+        await g.users_demote(group_id, user_ids, caller_id)
+
+    async def group_user_join(
+        self, group_id: str, user_id: str, username: str = ""
+    ):
+        g = self._component("groups")
+        await g.join(group_id, user_id, username)
+
+    async def group_user_leave(
+        self, group_id: str, user_id: str, username: str = ""
+    ):
+        g = self._component("groups")
+        await g.leave(group_id, user_id)
+
+    async def groups_list(
+        self, name: str = "", lang_tag: str = "", open: bool | None = None,
+        limit: int = 100, cursor: str = "",
+    ) -> dict:
+        g = self._component("groups")
+        return await g.list(
+            name=name or None, limit=limit, cursor=cursor, open=open,
+            lang_tag=lang_tag or None,
+        )
+
+    async def groups_get_random(self, count: int) -> list[dict]:
+        g = self._component("groups")
+        return await g.get_random(count)
+
     async def user_groups_list(self, user_id: str, **kwargs):
         g = self._component("groups")
         return await g.user_groups_list(user_id, **kwargs)
@@ -577,6 +966,34 @@ class NakamaModule:
         ch = self._component("channels")
         return ch.channel_id_build(sender_id, target, chan_type)
 
+    async def channel_messages_list(
+        self, channel_id: str, limit: int = 100, forward: bool = True,
+        cursor: str = "",
+    ) -> dict:
+        ch = self._component("channels")
+        return await ch.messages_list(
+            channel_id, limit=limit, forward=forward, cursor=cursor
+        )
+
+    async def channel_message_update(
+        self, channel_id: str, message_id: str, content: dict,
+        sender_id: str = "", sender_username: str = "",
+    ) -> dict:
+        ch = self._component("channels")
+        return await ch.message_update(
+            channel_id, message_id, content, sender_id, sender_username
+        )
+
+    async def channel_message_remove(
+        self, channel_id: str, message_id: str, sender_id: str = "",
+        sender_username: str = "",
+    ) -> dict:
+        ch = self._component("channels")
+        return await ch.message_remove(
+            channel_id, message_id, sender_id, sender_username,
+            authoritative=True,
+        )
+
     # -------------------------------------------------------------- events
 
     def event(self, name: str, properties: dict | None = None) -> None:
@@ -591,6 +1008,30 @@ class NakamaModule:
                 "timestamp": int(time.time()),
             },
         )
+
+    def set_event_fn(self, fn) -> None:
+        """Register a custom-event handler after init (reference
+        SetEventFn, runtime_go_nakama.go)."""
+        rt = self._component("runtime")
+        rt._event_fns.append(fn)
+
+    def read_file(self, relative_path: str) -> str:
+        """Read a file under the runtime module path — the module data
+        directory, never the host filesystem (reference ReadFile,
+        runtime_go_nakama.go: rooted at the runtime path)."""
+        import os
+
+        path = getattr(self.config.runtime, "path", "")
+        if not path:
+            # Without a configured module directory there is no sandbox
+            # root; rooting at the process CWD would expose host files.
+            raise RuntimeError("runtime.path not configured")
+        root = os.path.abspath(path)
+        full = os.path.abspath(os.path.join(root, relative_path))
+        if full == root or not full.startswith(root + os.sep):
+            raise ValueError("path escapes the runtime directory")
+        with open(full, "r", encoding="utf-8") as f:
+            return f.read()
 
     # ------------------------------------------------------------- metrics
 

@@ -254,6 +254,8 @@ class NakamaServer:
                 groups=self.groups,
                 notifications=self.notifications,
                 wallet=self.wallets,
+                purchases=self.purchases,
+                social=self.social,
             )
             self.attach_runtime(runtime)
         if self.runtime is not None:
