@@ -295,6 +295,92 @@ def measure_device(
     return p99_ms, median_ms, matched_total[0], sorted(latencies)
 
 
+def measure_cadence_latency(rng, pool, cadence_sec, cycles):
+    """Pipeline DELIVERY latency at a real interval cadence: wall-clock
+    from a ticket's add (stamped just before its dispatching process())
+    to its matched callback, replaying the production loop's schedule
+    (head-gap drain/gc/flush, mid-gap pipelined collection at fixed
+    points in the gap — matchmaker/local.py _loop). This is the lag the
+    PIPELINE adds on top of the wait-to-dispatch; a worst-case arrival
+    (just after the previous process) waits up to interval_sec more, so
+    worst-case add→matched = cadence_sec + this. Returns (p50_ms,
+    p99_ms, samples)."""
+    from nakama_tpu.config import MatchmakerConfig
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker
+    from nakama_tpu.matchmaker.tpu import TpuBackend
+
+    cap = 1 << (pool + pool // 2 - 1).bit_length()
+    cfg = MatchmakerConfig(
+        pool_capacity=cap,
+        candidates_per_ticket=32,
+        numeric_fields=8,
+        string_fields=8,
+        max_constraints=8,
+        max_intervals=2,
+        interval_pipelining=True,
+        interval_sec=int(cadence_sec),
+    )
+    backend = TpuBackend(cfg, test_logger(), row_block=256, col_block=2048)
+    add_time = {}
+    latencies = []
+
+    def on_matched(batch):
+        now = time.perf_counter()
+        if not add_time:
+            return
+        for entry_set in batch:
+            for e in entry_set:
+                t0 = add_time.pop(e.ticket, None)
+                if t0 is not None:
+                    latencies.append((now - t0) * 1000)
+
+    mm = LocalMatchmaker(
+        test_logger(), cfg, backend=backend, on_matched=on_matched
+    )
+    g0, g1, g2_saved = gc.get_threshold()
+    gc.set_threshold(g0, g1, 1_000_000)
+    fill(mm, rng, pool, "c")
+    mm.process()  # dispatch cohort 0; warm compiles ride cycle 0's gap
+
+    for cycle in range(cycles):
+        sampling = cycle > 0  # cycle 0 is warmup (compiles in-flight)
+        deficit = pool - len(mm)
+        before = set(mm.tickets) if sampling and deficit else None
+        if deficit > 0:
+            fill(mm, rng, deficit, f"c{cycle}-")
+        if before is not None:
+            now = time.perf_counter()
+            for i, t in enumerate(mm.tickets):
+                if t not in before and i % 200 == 0:
+                    add_time[t] = now
+        t0 = time.perf_counter()
+        mm.process()  # dispatches the just-stamped tickets
+        # The production gap schedule (local.py _loop) on absolute
+        # deadlines from the dispatch.
+        gap = min(2.0, cadence_sec / 4)
+        time.sleep(max(0.0, t0 + gap - time.perf_counter()))
+        mm.store.drain()
+        gc.collect()
+        backend.pool.flush()
+        for frac in (0.3, 0.5, 0.7, 0.9):
+            time.sleep(
+                max(0.0, t0 + cadence_sec * frac - time.perf_counter())
+            )
+            mm.collect_pipelined()
+        time.sleep(max(0.0, t0 + cadence_sec - time.perf_counter()))
+    mm.stop()
+    gc.set_threshold(g0, g1, g2_saved)
+    lat = sorted(latencies)
+    if not lat:
+        return 0.0, 0.0, 0
+    return (
+        lat[len(lat) // 2],
+        lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+        len(lat),
+    )
+
+
 def main():
     import numpy as np
 
@@ -460,11 +546,47 @@ def main():
             ns_result = run_north_star()
             emit_ns(*ns_result)
 
+    def run_cadence():
+        # TRUE production-cadence latency (VERDICT r3 #1): a real
+        # interval_sec cadence with the mid-gap delivery the production
+        # loop runs. 15s cycles are wall-clock — keep the cycle count
+        # small.
+        cadence = float(os.environ.get("BENCH_CADENCE_SEC", 15))
+        cycles = int(os.environ.get("BENCH_CADENCE_CYCLES", 4))
+        if os.environ.get("BENCH_VERBOSE"):
+            print(f"cadence latency: {cadence}s x {cycles}", file=sys.stderr)
+        p50, p99l, n = measure_cadence_latency(rng, NS_POOL, cadence, cycles)
+        print(
+            json.dumps(
+                {
+                    "metric": "matchmaker_pipeline_delivery_at_"
+                    f"{int(cadence)}s_cadence_ms",
+                    "value": round(p99l, 2),
+                    "unit": "ms",
+                    "median_ms": round(p50, 2),
+                    "samples": n,
+                    "note": (
+                        "wall-clock dispatch→matched at the real"
+                        f" {int(cadence)}s production cadence: mid-gap"
+                        " pipelined delivery ships a cohort seconds"
+                        " after its device pass, not at the next"
+                        " interval. Worst-case add→matched ="
+                        f" {int(cadence)}s (a ticket arriving right"
+                        " after a process waits one interval to"
+                        " dispatch) + this value"
+                    ),
+                }
+            ),
+            flush=True,
+        )
+
     if ns_wanted:
         if ns_result is None:
             ns_result = run_north_star()
         if not os.environ.get("BENCH_SKIP_NONPIPELINED"):
             run_nonpipelined()
+        if not os.environ.get("BENCH_SKIP_CADENCE"):
+            run_cadence()
         # ...and is re-emitted LAST so a tail-line parser reads the
         # headline metric (same measurement, duplicate line by design).
         emit_ns(*ns_result)

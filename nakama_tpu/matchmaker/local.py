@@ -338,7 +338,22 @@ class LocalMatchmaker:
                         flush()
                 except Exception as e:
                     self.logger.error("gap flush error", error=str(e))
-                await asyncio.sleep(self.config.interval_sec - gap)
+                # Mid-gap delivery: ready cohorts ship NOW rather than
+                # at the next process() — at production cadence this
+                # takes a full interval_sec off add→matched. Two
+                # attempts spread over the remaining sleep so a slower
+                # device pass still delivers in-gap.
+                rest = self.config.interval_sec - gap
+                for _ in range(2):
+                    await asyncio.sleep(rest / 2)
+                    if self._stopped or self._paused:
+                        break
+                    try:
+                        self.collect_pipelined()
+                    except Exception as e:
+                        self.logger.error(
+                            "mid-gap collection error", error=str(e)
+                        )
                 if not self._paused:
                     try:
                         self.process()
@@ -443,6 +458,32 @@ class LocalMatchmaker:
         self._update_gauges()
 
     # -------------------------------------------------------------- process
+
+    def collect_pipelined(self) -> MatchBatch | None:
+        """Deliver any pipelined cohorts whose device pass + gap assembly
+        already completed — called mid-gap by the interval loop so a
+        match reaches players seconds after its dispatch instead of a
+        full interval later. No-op (None) for backends without a
+        pipeline or when nothing is ready."""
+        collect = getattr(self.backend, "collect_ready", None)
+        if collect is None:
+            return None
+        out = collect(rev_precision=self.config.rev_precision)
+        if out is None:
+            return None
+        batch, matched_slots, reactivate = out
+        if len(matched_slots):
+            self.backend.on_remove_slots(matched_slots)
+            objs = self.store.remove_slots(matched_slots)
+            if batch.offsets is not None:
+                batch.bind_tickets(objs)
+        self.store.reactivate(reactivate)
+        if self.metrics is not None:
+            self.metrics.mm_matched.inc(batch.entry_count if batch else 0)
+            self._update_gauges()
+        if len(batch) and self.on_matched is not None:
+            self.on_matched(batch)
+        return batch
 
     def process(self) -> MatchBatch:
         """One matching interval (reference Process, matchmaker.go:282-441).

@@ -621,62 +621,111 @@ class TpuBackend:
                     sel[slots_m] = True
 
         for work in ready_works:
-            w_pending, w_slots, w_last, w_n, w_gen = work
-            if pipelined:
-                # Release only slots whose in-flight claim is still THIS
-                # cohort's: a slot freed, reused, and re-dispatched by a
-                # later still-queued cohort (gen changed) keeps its bit or
-                # the next interval triple-dispatches it.
-                self._in_flight_mask[
-                    w_slots[w_gen[w_slots] == self.store.gen[w_slots]]
-                ] = False
-            with span(crumb, "collect_s"):
-                # Fetch + exact-ordering + native assembly + host
-                # validation all ran on the cohort's worker thread in the
-                # interval gap (_bg_asm); a ready cohort hands back
-                # finished matches and this join is free. Staleness from
-                # gap-time assembly (a slot reused or removed while the
-                # thread ran) is exactly the staleness the accept step
-                # below already drops via gen/alive masks.
-                n_matches, offsets, flat, ok = self._collect(w_pending)
-            with span(crumb, "accept_s"):
-                total = int(offsets[n_matches])
-                flat_t = flat[:total]
-                sizes = (
-                    offsets[1 : n_matches + 1] - offsets[:n_matches]
-                ).astype(np.int64)
-                mid = np.repeat(np.arange(n_matches), sizes)
-                # stale: a slot was reused between dispatch and collection
-                # (pipelined interval) — its properties/query no longer
-                # match what the kernel scored; dead: removed meanwhile;
-                # sel: claimed by an earlier accepted match this interval.
-                bad_e = (
-                    (w_gen[flat_t] != self.store.gen[flat_t])
-                    | ~self.store.alive[flat_t]
-                    | sel[flat_t]
-                )
-                bad = ~ok
-                if bad_e.any():
-                    # bincount over the bad entries' match ids: ~10x the
-                    # buffered np.logical_or.at at 100k entries.
-                    bad |= (
-                        np.bincount(mid[bad_e], minlength=n_matches) > 0
-                    )
-                if pipelined and bad.any():
-                    # Only the pipeline lag can strand an inactive ticket;
-                    # non-pipelined drops keep reference single-shot
-                    # semantics.
-                    dropped = flat_t[bad[mid]]
-                    dropped = dropped[
-                        self.store.alive[dropped] & ~sel[dropped]
-                    ]
-                    react_parts.append(dropped)
-                good = ~bad
-                good_flat = flat_t[good[mid]]
-                sel[good_flat] = True
-                flat_parts.append(good_flat)
-                size_parts.append(sizes[good])
+            self._accept_work(
+                work, crumb, sel, flat_parts, size_parts, react_parts,
+                pipelined,
+            )
 
+        batch, matched_slots, reactivate = self._finalize_batch(
+            sel, flat_parts, size_parts, react_parts
+        )
+        crumb["matched_entries"] = batch.entry_count
+        self.tracing.record(crumb)
+        return batch, matched_slots, reactivate
+
+    def collect_ready(self, *, rev_precision: bool):
+        """Drain completed pipelined cohorts OUTSIDE process(): the
+        interval loop calls this mid-gap, so a cohort delivers as soon as
+        its device pass + gap assembly finish (~seconds into the gap)
+        instead of waiting for the NEXT interval — cutting a full
+        interval_sec off add→matched latency at production cadence. Same
+        accept path, no new dispatch. Returns (batch, matched_slots,
+        reactivate) or None when nothing is ready."""
+        if not self._pipeline_queue:
+            return None
+        ready_works: list[tuple] = []
+        while self._pipeline_queue and _work_ready(self._pipeline_queue[0]):
+            ready_works.append(self._pipeline_queue.popleft())
+        if not ready_works:
+            return None
+        crumb: dict = {"midgap_collect": True}
+        sel = self._sel_mask
+        sel[:] = False
+        flat_parts: list[np.ndarray] = []
+        size_parts: list[np.ndarray] = []
+        react_parts: list[np.ndarray] = []
+        for work in ready_works:
+            self._accept_work(
+                work, crumb, sel, flat_parts, size_parts, react_parts,
+                pipelined=True,
+            )
+        out = self._finalize_batch(sel, flat_parts, size_parts, react_parts)
+        crumb["matched_entries"] = out[0].entry_count
+        self.tracing.record(crumb)
+        return out
+
+    def _accept_work(
+        self, work, crumb, sel, flat_parts, size_parts, react_parts,
+        pipelined,
+    ):
+        span = self.tracing.span
+        w_pending, w_slots, w_last, w_n, w_gen = work
+        if pipelined:
+            # Release only slots whose in-flight claim is still THIS
+            # cohort's: a slot freed, reused, and re-dispatched by a
+            # later still-queued cohort (gen changed) keeps its bit or
+            # the next interval triple-dispatches it.
+            self._in_flight_mask[
+                w_slots[w_gen[w_slots] == self.store.gen[w_slots]]
+            ] = False
+        with span(crumb, "collect_s"):
+            # Fetch + exact-ordering + native assembly + host
+            # validation all ran on the cohort's worker thread in the
+            # interval gap (_bg_asm); a ready cohort hands back
+            # finished matches and this join is free. Staleness from
+            # gap-time assembly (a slot reused or removed while the
+            # thread ran) is exactly the staleness the accept step
+            # below already drops via gen/alive masks.
+            n_matches, offsets, flat, ok = self._collect(w_pending)
+        with span(crumb, "accept_s"):
+            total = int(offsets[n_matches])
+            flat_t = flat[:total]
+            sizes = (
+                offsets[1 : n_matches + 1] - offsets[:n_matches]
+            ).astype(np.int64)
+            mid = np.repeat(np.arange(n_matches), sizes)
+            # stale: a slot was reused between dispatch and collection
+            # (pipelined interval) — its properties/query no longer
+            # match what the kernel scored; dead: removed meanwhile;
+            # sel: claimed by an earlier accepted match this interval.
+            bad_e = (
+                (w_gen[flat_t] != self.store.gen[flat_t])
+                | ~self.store.alive[flat_t]
+                | sel[flat_t]
+            )
+            bad = ~ok
+            if bad_e.any():
+                # bincount over the bad entries' match ids: ~10x the
+                # buffered np.logical_or.at at 100k entries.
+                bad |= (
+                    np.bincount(mid[bad_e], minlength=n_matches) > 0
+                )
+            if pipelined and bad.any():
+                # Only the pipeline lag can strand an inactive ticket;
+                # non-pipelined drops keep reference single-shot
+                # semantics.
+                dropped = flat_t[bad[mid]]
+                dropped = dropped[
+                    self.store.alive[dropped] & ~sel[dropped]
+                ]
+                react_parts.append(dropped)
+            good = ~bad
+            good_flat = flat_t[good[mid]]
+            sel[good_flat] = True
+            flat_parts.append(good_flat)
+            size_parts.append(sizes[good])
+
+    def _finalize_batch(self, sel, flat_parts, size_parts, react_parts):
         if flat_parts:
             matched_slots = np.concatenate(flat_parts).astype(
                 np.int32, copy=False
@@ -689,16 +738,14 @@ class TpuBackend:
             offsets_out = np.zeros(1, dtype=np.int64)
         # Ticket snapshot deferred: LocalMatchmaker binds the removal
         # path's parked object array (same slots, same order).
-        batch = MatchBatch(offsets_out, matched_slots, counts=meta["count"])
-
+        batch = MatchBatch(
+            offsets_out, matched_slots, counts=self.meta["count"]
+        )
         if react_parts:
             reactivate = np.unique(np.concatenate(react_parts))
             reactivate = reactivate[~sel[reactivate]].astype(np.int32)
         else:
             reactivate = np.zeros(0, dtype=np.int32)
-
-        crumb["matched_entries"] = batch.entry_count
-        self.tracing.record(crumb)
         return batch, matched_slots, reactivate
 
     def wait_idle(self, timeout: float | None = None):

@@ -673,11 +673,12 @@ class NakamaModule:
 
     def stream_user_update(
         self, stream: dict, user_id: str, session_id: str,
-        hidden: bool = False, persistence: bool = True,
+        hidden: bool = False, persistence: bool = True, status: str = "",
     ) -> bool:
         sm = self._component("stream_manager")
         return sm.user_update(
-            self._stream(stream), user_id, session_id, hidden, persistence
+            self._stream(stream), user_id, session_id, hidden, persistence,
+            status,
         )
 
     def stream_user_kick(
@@ -693,7 +694,7 @@ class NakamaModule:
         tracker = self._component("tracker")
         s = self._stream(stream)
         for p in list(tracker.list_by_stream(s)):
-            tracker.untrack(p.session_id, s)
+            tracker.untrack(p.id.session_id, s)
 
     def stream_send_raw(self, stream: dict, envelope: dict) -> None:
         """Deliver a raw rtapi envelope dict to a stream (reference

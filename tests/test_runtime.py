@@ -506,3 +506,33 @@ async def test_nk_round4_functions_behave(tmp_path):
             nk.read_file("../outside.txt")
     finally:
         await server.stop()
+
+
+async def test_nk_stream_close_untracks_presences():
+    # Regression (round-4 review): stream_close read p.session_id off
+    # the Presence dataclass (the session id lives at p.id.session_id)
+    # and raised AttributeError on any non-empty stream.
+    from fixtures import quiet_logger
+
+    from nakama_tpu.config import Config
+    from nakama_tpu.realtime import PresenceMeta, Stream, StreamMode
+    from nakama_tpu.runtime.nk import NakamaModule
+    from nakama_tpu.realtime.tracker import LocalTracker
+
+    config = Config()
+    tracker = LocalTracker(quiet_logger(), node="t")
+    nk = NakamaModule(quiet_logger(), config, tracker=tracker)
+    stream = Stream(StreamMode.STATUS, subject="close-me")
+    tracker.track(
+        "sess-1", stream, "user-1", PresenceMeta(username="u1"),
+        allow_if_first_for_session=True,
+    )
+    assert nk.stream_count(
+        {"mode": int(StreamMode.STATUS), "subject": "close-me"}
+    ) == 1
+    nk.stream_close(
+        {"mode": int(StreamMode.STATUS), "subject": "close-me"}
+    )
+    assert nk.stream_count(
+        {"mode": int(StreamMode.STATUS), "subject": "close-me"}
+    ) == 0
