@@ -1177,9 +1177,16 @@ class ConsoleServer:
                 return _err(400, "before must be epoch seconds")
         total = 0
         if ids:
-            for mid in ids:
+            # Chunked IN-clause: one write transaction per chunk, not one
+            # per id — bulk deletes must not serialize thousands of
+            # commits onto the single-writer engine.
+            ids = [str(m) for m in ids]
+            for i in range(0, len(ids), 256):
+                chunk = ids[i : i + 256]
+                marks = ",".join("?" * len(chunk))
                 total += await self.server.db.execute(
-                    "DELETE FROM message WHERE id = ?", (str(mid),)
+                    f"DELETE FROM message WHERE id IN ({marks})",
+                    tuple(chunk),
                 )
         if before is not None:
             total += await self.server.db.execute(

@@ -280,7 +280,13 @@ class PoolBuffer:
                     "pool scatter prewarm failed: %s", e
                 )
 
-        threading.Thread(target=_warm, daemon=True).start()
+        self._prewarm_thread = threading.Thread(target=_warm, daemon=True)
+        self._prewarm_thread.start()
+
+    def join_prewarm(self, timeout=None):
+        t = getattr(self, "_prewarm_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout)
 
     def flush(self):
         """Apply queued updates: one flags-invalidate scatter for removals

@@ -236,6 +236,10 @@ class TpuBackend:
         self._in_flight_mask = np.zeros(cap, dtype=bool)
         # Row-bucket shapes already compiled (or prewarmed) this process.
         self._warmed_buckets: set[tuple] = set()
+        # Live prewarm threads: joined at wait_idle/shutdown — a daemon
+        # thread cancelled mid-XLA-compile at interpreter teardown
+        # aborts the process ("FATAL: exception not rethrown").
+        self._warm_threads: list[threading.Thread] = []
         # Insertion-ordered slot ring: adds append here, so the ring IS
         # the (created_at, created_seq) dispatch order — the per-dispatch
         # lexsort over ~100k actives measured 8.7ms/interval. Entries of
@@ -753,9 +757,18 @@ class TpuBackend:
         assembly completed (the results stay queued for the next process()
         to collect). Used between intervals by the bench to model the
         production interval gap, and at shutdown so no worker thread
-        outlives the runtime."""
+        outlives the runtime (incl. prewarm compiles: XLA aborts the
+        process if a compile thread dies at teardown)."""
         for work in list(self._pipeline_queue):
             work[0][-1].join(timeout)
+        live = []
+        for t in self._warm_threads:
+            if t.is_alive():
+                t.join(timeout)
+                if t.is_alive():
+                    live.append(t)
+        self._warm_threads = live
+        self.pool.join_prewarm(timeout)
 
     # ----------------------------------------------------- dispatch order
 
@@ -875,16 +888,17 @@ class TpuBackend:
             # 48/112-style buckets). The <=2x padded rows are pipelined
             # MXU time nobody waits on.
             a_pad = _pow2_blocks(-(-len(slots) // bm)) * bm
-            self._prewarm_row_bucket(
-                a_pad, n_cols, rev, with_should, with_embedding, bm, bn
-            )
-
-            grid_lo, grid_inv = self._grid_params()
             use_pairs = (
                 self.config.device_pairing
                 and not self.config.interval_pipelining
                 and self._nonpair_count == 0
             )
+            self._prewarm_row_bucket(
+                a_pad, n_cols, rev, with_should, with_embedding, bm, bn,
+                order_exact=not use_pairs,
+            )
+
+            grid_lo, grid_inv = self._grid_params()
             cand_dev = topk_candidates_big(
                 self.pool.device,
                 pad_to(slots, a_pad, -1),
@@ -1192,54 +1206,93 @@ class TpuBackend:
         return self._bg_asm("small", (scores, cand), slots, last, rev)
 
     def _prewarm_row_bucket(
-        self, a_pad, n_cols, rev, with_should, with_embedding, bm, bn
+        self, a_pad, n_cols, rev, with_should, with_embedding, bm, bn,
+        order_exact=True,
     ):
-        """Whenever a row bucket is dispatched, make sure the NEXT-SMALLER
-        bucket is compiled too: active counts decay from the initial
-        full-pool burst toward steady state, and without this the first
-        interval crossing a pow2 boundary eats a multi-second XLA compile
-        right in the p99 (measured 3.7-10s). Checked on EVERY dispatch so
-        the chain propagates (128 warms 64, 64 warms 32, ...). The compile
-        runs on a daemon thread — jit compilation is synchronous on its
-        calling thread but the jit cache is process-wide, so the warm
-        happens off the interval critical path; the dummy execution is a
-        fully-masked half-size pass, a one-off per bucket."""
+        """Whenever a row bucket is dispatched, compile EVERY smaller
+        bucket down to one block on a background thread: active counts
+        both decay gradually and COLLAPSE suddenly (a big cohort matches
+        wholesale and the next dispatch is a fraction of the size —
+        cfg4-style pools), and any bucket first seen inside a timed
+        interval eats its multi-second XLA compile right in the p99
+        (measured 3.7-10s). jit compilation is synchronous on its calling
+        thread but the cache is process-wide, so one daemon thread
+        compiling the chain during the first interval's gap covers all
+        later shrinkage; each dummy execution is a fully-masked pass."""
         self._warmed_buckets.add((a_pad, n_cols, rev, with_should,
-                                  with_embedding))
+                                  with_embedding, order_exact))
+        sizes = []
         half = a_pad // 2
-        half_key = (half, n_cols, rev, with_should, with_embedding)
-        if half < bm or half_key in self._warmed_buckets:
+        while half >= bm:
+            key = (half, n_cols, rev, with_should, with_embedding,
+                   order_exact)
+            if key not in self._warmed_buckets:
+                self._warmed_buckets.add(key)
+                sizes.append(half)
+            half //= 2
+        if not sizes:
             return
-        self._warmed_buckets.add(half_key)
-        dummy = np.full(half, -1, np.int32)
         grid_lo = np.zeros(self.fn, np.float32)
         grid_inv = np.ones(self.fn, np.float32)
-        pool_dev = self.pool.device
+        # Shapes only, never the live buffers: every flush DONATES
+        # pool.device, so a captured reference dies the moment the next
+        # interval flushes and the whole chain would silently fail (and
+        # re-spawn, every dispatch). The jit cache keys on abstract
+        # shapes, so compiling against a scratch clone warms the real
+        # path; the scratch is transient device memory released when the
+        # thread exits.
+        shapes = {k: (v.shape, v.dtype) for k, v in self.pool.device.items()}
 
         def _warm():
-            try:
-                topk_candidates_big(
-                    pool_dev,
-                    dummy,
-                    grid_lo,
-                    grid_inv,
-                    fn=self.fn,
-                    fs=self.fs,
-                    n_cols=n_cols,
-                    k=self.k,
-                    rev=rev,
-                    with_should=with_should,
-                    with_embedding=with_embedding,
-                    bm=bm,
-                    bn=bn,
-                    interpret=self._interpret,
-                    emb_scale=self.config.emb_score_scale,
-                )
-            except Exception as e:  # best-effort: never break dispatch
-                self._warmed_buckets.discard(half_key)
-                self.logger.debug("bucket prewarm failed", error=str(e))
+            import jax.numpy as jnp
 
-        threading.Thread(target=_warm, daemon=True).start()
+            scratch = {
+                k: jnp.zeros(shp, dt) for k, (shp, dt) in shapes.items()
+            }
+            for size in sizes:
+                try:
+                    warm_cand = topk_candidates_big(
+                        scratch,
+                        np.full(size, -1, np.int32),
+                        grid_lo,
+                        grid_inv,
+                        fn=self.fn,
+                        fs=self.fs,
+                        n_cols=n_cols,
+                        k=self.k,
+                        rev=rev,
+                        with_should=with_should,
+                        with_embedding=with_embedding,
+                        bm=bm,
+                        bn=bn,
+                        interpret=self._interpret,
+                        emb_scale=self.config.emb_score_scale,
+                        order_exact=order_exact,
+                    )
+                    if not order_exact:
+                        # Pairs mode: the handshake compiles per row
+                        # bucket too.
+                        import jax.numpy as jnp
+
+                        from .device2 import pair_partners
+
+                        pair_partners(
+                            warm_cand,
+                            jnp.asarray(np.full(size, -1, np.int32)),
+                            cap=self.pool.capacity,
+                        )
+                except Exception as e:  # best-effort: never break dispatch
+                    self._warmed_buckets.discard(
+                        (size, n_cols, rev, with_should, with_embedding,
+                         order_exact)
+                    )
+                    self.logger.debug(
+                        "bucket prewarm failed", error=str(e)
+                    )
+
+        t = threading.Thread(target=_warm, daemon=True)
+        self._warm_threads.append(t)
+        t.start()
 
     def _collect(self, pending):
         """Pick up the worker thread's finished (n_matches, offsets, flat,
