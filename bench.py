@@ -293,7 +293,7 @@ def measure_device(
             if crumbs:
                 c = crumbs[-1]
                 spans = " " + " ".join(
-                    f"{k}={v*1000:.1f}" if k.endswith("_s")
+                    f"{k[:-2]}_ms={v*1000:.1f}" if k.endswith("_s")
                     else f"{k}={v}"
                     for k, v in c.items()
                     if k != "ts"
@@ -409,6 +409,7 @@ def measure_write_load(rng, pool, intervals=5):
     counts = {"writes": 0}
     stop = threading.Event()
     ready = threading.Event()
+    worker_errs: list = []
 
     def db_worker():
         async def run():
@@ -458,7 +459,10 @@ def measure_write_load(rng, pool, intervals=5):
                 i += 1
             await db.close()
 
-        asyncio.run(run())
+        try:
+            asyncio.run(run())
+        except Exception as e:  # surfaced after the run: a dead worker
+            worker_errs.append(e)  # must fail the metric, not zero it
 
     cfg, backend = _mk_backend(pool)
     mm = LocalMatchmaker(test_logger(), cfg, backend=backend)
@@ -494,6 +498,10 @@ def measure_write_load(rng, pool, intervals=5):
     stop.set()
     thread.join(20)
     mm.stop()
+    if worker_errs:
+        raise RuntimeError(
+            f"db write worker died mid-run: {worker_errs[0]!r}"
+        )
     gc.set_threshold(g0, g1, g2_saved)
     timings = sorted(timings)
     p99 = timings[min(len(timings) - 1, int(len(timings) * 0.99))] * 1000
@@ -507,6 +515,16 @@ def main():
 
     device = jax.devices()[0].platform
     rng = np.random.default_rng(42)
+
+    if device != "cpu" and not os.environ.get("BENCH_SKIP_SELFCHECK"):
+        # Chip-executed correctness first (VERDICT r3 #7): the same
+        # parity assertions the @pytest.mark.tpu tier runs — a Mosaic
+        # miscompile must fail the bench, not skew its numbers.
+        from nakama_tpu.matchmaker.selfcheck import run_chip_selfcheck
+
+        run_chip_selfcheck(
+            log=lambda *a: print(*a, file=sys.stderr, flush=True)
+        )
 
     oracle_s = measure_oracle(rng, ORACLE_POOL, build_ticket)
 

@@ -759,16 +759,28 @@ class TpuBackend:
         production interval gap, and at shutdown so no worker thread
         outlives the runtime (incl. prewarm compiles: XLA aborts the
         process if a compile thread dies at teardown)."""
+        import time as _time
+
+        deadline = (
+            None if timeout is None else _time.monotonic() + timeout
+        )
+
+        def _left():
+            if deadline is None:
+                return None
+            return max(0.0, deadline - _time.monotonic())
+
         for work in list(self._pipeline_queue):
-            work[0][-1].join(timeout)
-        live = []
+            work[0][-1].join(_left())
+        # Warm threads join WITHOUT the deadline: they are pure XLA
+        # compiles (bounded, ~seconds) and a daemon compile thread left
+        # alive at interpreter teardown aborts the whole process — a
+        # slightly slower stop() beats 'FATAL: exception not rethrown'.
         for t in self._warm_threads:
             if t.is_alive():
-                t.join(timeout)
-                if t.is_alive():
-                    live.append(t)
-        self._warm_threads = live
-        self.pool.join_prewarm(timeout)
+                t.join()
+        self._warm_threads = []
+        self.pool.join_prewarm()
 
     # ----------------------------------------------------- dispatch order
 
@@ -1272,8 +1284,6 @@ class TpuBackend:
                     if not order_exact:
                         # Pairs mode: the handshake compiles per row
                         # bucket too.
-                        import jax.numpy as jnp
-
                         from .device2 import pair_partners
 
                         pair_partners(

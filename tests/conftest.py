@@ -13,25 +13,41 @@ import asyncio
 import inspect
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # hermetic: never grab the real TPU
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_TPU_TIER = bool(os.environ.get("NAKAMA_TPU_TESTS"))
+
+if not _TPU_TIER:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # hermetic: never grab the real TPU
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 # Some images preload jax at interpreter startup (before conftest runs), so
 # the env vars above may be read too late. Force the same settings through the
 # live config API; this works as long as no backend has been initialised yet.
 import jax
 
-try:
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-except Exception:  # backend already up (e.g. single-process rerun) — tests will skip
-    pass
+if not _TPU_TIER:
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:  # backend already up — tests will skip
+        pass
 
 import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    """tpu-marked tests run only in the chip tier
+    (NAKAMA_TPU_TESTS=1 pytest -m tpu); the default CPU-forced run
+    skips them."""
+    if _TPU_TIER:
+        return
+    skip = pytest.mark.skip(reason="chip tier: NAKAMA_TPU_TESTS=1 -m tpu")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -140,7 +140,13 @@ def test_protobuf_bytes_golden_matchmaker_add():
 def test_deviations_are_documented():
     """The recorded deviations list must survive in rtapi.proto — it is
     the compatibility statement's source of truth."""
-    with open("nakama_tpu/proto/rtapi.proto") as f:
+    import os
+
+    proto = os.path.join(
+        os.path.dirname(__file__), "..", "nakama_tpu", "proto",
+        "rtapi.proto",
+    )
+    with open(proto) as f:
         head = f.read(2000)
     for marker in (
         "Deliberate contract deviations",
