@@ -96,6 +96,21 @@ def _load_path(path: str):
 
             out.append((fname, lua_init))
             continue
+        if fname.endswith(".js"):
+            # Guest-language provider #3 (runtime/js): evaluation defines
+            # InitModule, which registers hooks via the camelCase API.
+            with open(os.path.join(path, fname)) as fh:
+                source = fh.read()
+
+            def js_init(
+                ctx, log, nk, initializer, _src=source, _name=fname
+            ):
+                from .js import load_js_module
+
+                load_js_module(_name, _src, log, nk, initializer)
+
+            out.append((fname, js_init))
+            continue
         if not fname.endswith(".py"):
             continue
         mod_name = f"nakama_runtime_{fname[:-3]}"

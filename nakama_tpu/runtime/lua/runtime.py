@@ -167,11 +167,16 @@ class LuaModule:
             t.set("vars", to_lua(dict(vars_)))
         return t
 
-    def _session_ctx(self, session) -> LuaTable:
+    def _session_ctx(self, ctx) -> LuaTable:
+        # rt hooks receive a RuntimeContext (registry.before_rt wraps the
+        # session), whose session id attribute is session_id.
         t = LuaTable()
-        t.set("user_id", getattr(session, "user_id", ""))
-        t.set("username", getattr(session, "username", ""))
-        t.set("session_id", getattr(session, "id", ""))
+        t.set("user_id", getattr(ctx, "user_id", ""))
+        t.set("username", getattr(ctx, "username", ""))
+        t.set(
+            "session_id",
+            getattr(ctx, "session_id", "") or getattr(ctx, "id", ""),
+        )
         return t
 
     # --------------------------------------------------------- nk bridge
@@ -400,7 +405,9 @@ class LuaModule:
                 init.register_after_req(key_str, req_after)
         elif kind == "matchmaker_matched":
 
-            def matched_wrapper(entries, _fn=fn):
+            # Registry adapter calls user code as (ctx, entries)
+            # (registry.matchmaker_matched).
+            def matched_wrapper(ctx, entries, _fn=fn):
                 # Called synchronously from the matchmaker tail, which
                 # may be the event-loop thread: run inline with the
                 # no-async flag (the bridge fails fast instead of
@@ -418,7 +425,11 @@ class LuaModule:
                         for e in entries
                     ]
                 )
-                out = self._invoke(_fn, (lua_entries,), no_async=True)
+                # Guest signature (ctx, entries) — reference Lua API
+                # (runtime_lua_nakama.go matchmaker_matched).
+                out = self._invoke(
+                    _fn, (self._ctx_table(ctx), lua_entries), no_async=True
+                )
                 result = out[0] if out else None
                 return str(result) if result else ""
 

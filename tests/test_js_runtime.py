@@ -1,0 +1,559 @@
+"""Sandboxed JS runtime (VERDICT r3 #4): language subset semantics,
+sandbox guarantees (fuel, depth, no ambient capabilities), and the
+end-to-end story — a .js module registering rpc + before-hook +
+matchmakerMatched against a live server, exercised over HTTP/WS.
+Mirrors test_lua_runtime for guest language #3.
+
+Reference counterpart: server/runtime_javascript.go +
+runtime_javascript_nakama.go (the embedded goja engine); this is an
+original subset interpreter wired into the SAME hook registry as the
+Python and Lua providers.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+import websockets
+
+from fixtures import quiet_logger
+
+from nakama_tpu.config import Config
+from nakama_tpu.runtime.js.interp import (
+    Env,
+    Interp,
+    JsFuelError,
+    JsRuntimeError,
+    JsThrow,
+    UNDEFINED,
+)
+from nakama_tpu.runtime.js.parser import parse
+from nakama_tpu.runtime.js.stdlib import from_js, new_globals
+from nakama_tpu.server import NakamaServer
+
+
+def run(src: str, fuel: int | None = None):
+    out = []
+    g = new_globals(print_fn=out.append)
+    interp = Interp(g)
+    interp.fuel = fuel if fuel is not None else 2_000_000
+    interp.run_chunk(parse(src, "test"))
+    return out, interp
+
+
+# ------------------------------------------------------------- language
+
+
+def test_js_core_semantics():
+    out, _ = run(
+        """
+        var total = 0;
+        for (let i = 1; i <= 100; i++) { total += i; }
+        console.log(total);
+        function fib(n) { return n < 2 ? n : fib(n - 1) + fib(n - 2); }
+        console.log(fib(15));
+        let m = 0;
+        switch (2) {
+          case 1: m = 1; break;
+          case 2: m = 2;             // fallthrough
+          case 3: m += 10; break;
+          default: m = 99;
+        }
+        console.log(m);
+        let i = 0, acc = "";
+        do { acc += i; i++; } while (i < 3);
+        console.log(acc);
+        console.log(1 == "1", 1 === "1", null == undefined,
+                    null === undefined, NaN === NaN);
+        console.log(typeof 1, typeof "s", typeof undefined,
+                    typeof null, typeof fib);
+        console.log(5 & 3, 5 | 2, 1 << 4, -8 >> 1, -8 >>> 28, ~0);
+        """
+    )
+    assert out == [
+        "5050",
+        "610",
+        "12",
+        "012",
+        "true false true false false",
+        "number string undefined object function",
+        "1 7 16 -4 15 -1",
+    ]
+
+
+def test_js_objects_arrays_json():
+    out, _ = run(
+        """
+        let o = {a: 1, "b": 2, ["c" + 1]: 3, short: 4};
+        o.d = Object.keys(o).length;
+        delete o.short;
+        console.log(JSON.stringify(o));
+        let arr = [5, 3, 1, 4].sort(function(a, b) { return a - b; });
+        console.log(arr.join("-"), arr.length, arr.indexOf(4));
+        let mapped = arr.map(x => x * 2).filter(x => x > 4);
+        console.log(JSON.stringify(mapped));
+        console.log(arr.reduce((acc, x) => acc + x, 100));
+        let round = JSON.parse('{"deep": {"list": [1, 2, {"k": "v"}]}}');
+        console.log(round.deep.list[2].k, "k" in round.deep.list[2]);
+        console.log("a,b,,c".split(",").length, "  pad  ".trim());
+        for (const entry of Object.entries({x: 9})) {
+            console.log(entry[0], entry[1]);
+        }
+        """
+    )
+    assert out == [
+        '{"a": 1, "b": 2, "c1": 3, "d": 4}',
+        "1-3-4-5 4 2",
+        "[6, 8, 10]",
+        "113",
+        "v true",
+        "4 pad",
+        "x 9",
+    ]
+
+
+def test_js_closures_arrows_and_this():
+    out, _ = run(
+        """
+        function counter() {
+            let n = 0;
+            return () => { n++; return n; };
+        }
+        const c = counter();
+        c(); c();
+        console.log(c());
+        const obj = {
+            v: 7,
+            plain: function() { return this.v; },
+            viaArrow: function() {
+                const get = () => this.v;  // arrow captures this
+                return get();
+            }
+        };
+        console.log(obj.plain(), obj.viaArrow());
+        const add = (a, b) => a + b;
+        console.log(add.call(undefined, 1, 2), add.apply(null, [3, 4]));
+        """
+    )
+    assert out == ["3", "7 7", "3 7"]
+
+
+def test_js_try_catch_throw_finally():
+    out, _ = run(
+        """
+        let steps = [];
+        try {
+            try { throw {code: 7, message: "boom"}; }
+            finally { steps.push("inner-finally"); }
+        } catch (e) {
+            steps.push("caught:" + e.code + ":" + e.message);
+        } finally {
+            steps.push("outer-finally");
+        }
+        try { undefinedFunction(); } catch (e) {
+            steps.push("runtime:" + (e.message.length > 0));
+        }
+        console.log(steps.join("|"));
+        """
+    )
+    assert out == [
+        "inner-finally|caught:7:boom|outer-finally|runtime:true"
+    ]
+
+
+def test_js_fuel_budget_uncatchable():
+    with pytest.raises(JsFuelError):
+        run("try { while (true) {} } catch (e) {}", fuel=50_000)
+
+
+def test_js_depth_cap():
+    with pytest.raises(JsRuntimeError, match="depth"):
+        run("function f() { return f(); } f();")
+
+
+def test_js_no_ambient_capabilities():
+    # The sandbox exposes NO host escape hatches: every ambient global
+    # common in real engines is absent.
+    for name in (
+        "require", "process", "globalThis", "eval", "Function",
+        "setTimeout", "fetch", "XMLHttpRequest", "Date",
+    ):
+        with pytest.raises((JsRuntimeError, JsThrow)):
+            run(f"{name}();")
+    # Math.random excluded for determinism.
+    out, _ = run("console.log(typeof Math.random);")
+    assert out == ["undefined"]
+
+
+def test_js_unsupported_syntax_is_loud():
+    from nakama_tpu.runtime.js.lexer import JsSyntaxError
+
+    for src in (
+        "class A {}",
+        "let x = new Thing();",
+        "let t = `template`;",
+        "function f(...rest) {}",
+        "let [a, b] = [1, 2];",
+    ):
+        with pytest.raises(JsSyntaxError):
+            run(src)
+
+
+def test_js_host_values_cross_by_conversion():
+    out, interp = run("var captured = null;")
+    g = interp.globals
+    from nakama_tpu.runtime.js.stdlib import to_js
+
+    host = {"list": [1, 2, {"k": "v"}], "flag": True, "none": None}
+    js_val = to_js(host)
+    back = from_js(js_val)
+    assert back == host
+    # Mutating the guest copy never touches the host dict.
+    js_val.props["flag"] = False
+    assert host["flag"] is True
+
+
+def test_js_asi_newline_termination():
+    out, _ = run(
+        """
+        let a = 1
+        let b = 2
+        console.log(a + b)
+        function f() {
+            return
+        }
+        console.log(f() === undefined)
+        """
+    )
+    assert out == ["3", "true"]
+
+
+# ----------------------------------------------------------- end-to-end
+
+JS_MODULE = """
+function InitModule(ctx, logger, nk, initializer) {
+    logger.info("js module loading");
+
+    initializer.registerRpc("js_double", function(ctx, payload) {
+        var input = JSON.parse(payload);
+        return JSON.stringify({
+            doubled: input.value * 2,
+            caller: ctx.userId
+        });
+    });
+
+    initializer.registerRpc("js_storage", function(ctx, payload) {
+        nk.storageWrite([{
+            collection: "jsdata", key: "slot", user_id: ctx.userId,
+            value: {from: "js"}
+        }]);
+        var got = nk.storageRead([{
+            collection: "jsdata", key: "slot", user_id: ctx.userId
+        }]);
+        return JSON.stringify({written: got.length === 1});
+    });
+
+    initializer.registerRtBefore("MatchmakerAdd", function(session, body) {
+        if (body.query === "forbidden") { return null; }
+        body.string_properties = {mode: "forced"};
+        body.query = "+properties.mode:forced";
+        return body;
+    });
+
+    initializer.registerMatchmakerMatched(function(entries) {
+        return "";  // default token minting
+    });
+}
+"""
+
+
+async def make_server(tmp_path):
+    mod_dir = tmp_path / "modules"
+    mod_dir.mkdir()
+    (mod_dir / "ext.js").write_text(JS_MODULE)
+    config = Config()
+    config.socket.port = 0
+    config.runtime.path = str(mod_dir)
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    return server
+
+
+async def test_js_module_rpc_and_hooks_end_to_end(tmp_path):
+    server = await make_server(tmp_path)
+    http = aiohttp.ClientSession()
+    try:
+        assert "ext.js" in server.runtime.modules
+        base = f"http://127.0.0.1:{server.port}"
+        import base64
+
+        basic = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"defaultkey:").decode()
+        }
+        async with http.post(
+            f"{base}/v2/account/authenticate/device",
+            headers=basic,
+            json={"account": {"id": "js-device-0000001"}},
+        ) as r:
+            session = await r.json()
+        bearer = {"Authorization": f"Bearer {session['token']}"}
+
+        # JS rpc over HTTP: payload round-trip through the guest.
+        async with http.post(
+            f"{base}/v2/rpc/js_double",
+            headers=bearer,
+            data=json.dumps(json.dumps({"value": 21})),
+        ) as r:
+            assert r.status == 200, await r.text()
+            out = json.loads((await r.json())["payload"])
+        assert out["doubled"] == 42
+        assert out["caller"]
+
+        # JS rpc calling async nk.storageWrite/storageRead.
+        async with http.post(
+            f"{base}/v2/rpc/js_storage", headers=bearer,
+            data=json.dumps(""),
+        ) as r:
+            assert r.status == 200, await r.text()
+            stored = json.loads((await r.json())["payload"])
+        assert stored == {"written": True}
+
+        # Socket: the JS before-hook rewrites matchmaker_add queries so
+        # two different queries still match; "forbidden" is rejected.
+        async def ws_connect(device):
+            async with http.post(
+                f"{base}/v2/account/authenticate/device",
+                headers=basic,
+                json={"account": {"id": device}},
+            ) as r:
+                tok = (await r.json())["token"]
+            return await websockets.connect(
+                f"ws://127.0.0.1:{server.port}/ws?token={tok}"
+            )
+
+        async def recv_key(ws, key, timeout=5.0):
+            while True:
+                e = json.loads(
+                    await asyncio.wait_for(ws.recv(), timeout=timeout)
+                )
+                if key in e:
+                    return e
+
+        a = await ws_connect("js-device-0000002")
+        b = await ws_connect("js-device-0000003")
+        await a.send(json.dumps({
+            "cid": "x",
+            "matchmaker_add": {
+                "min_count": 2, "max_count": 2, "query": "forbidden",
+            },
+        }))
+        with pytest.raises(asyncio.TimeoutError):
+            await recv_key(a, "matchmaker_ticket", timeout=0.3)
+
+        for ws, q in ((a, "+properties.mode:alpha"),
+                      (b, "+properties.mode:beta")):
+            await ws.send(json.dumps({
+                "cid": "mm",
+                "matchmaker_add": {
+                    "min_count": 2, "max_count": 2, "query": q,
+                    "string_properties": {"mode": "original"},
+                },
+            }))
+            await recv_key(ws, "matchmaker_ticket")
+        server.matchmaker.process()
+        ma = await recv_key(a, "matchmaker_matched")
+        mb = await recv_key(b, "matchmaker_matched")
+        assert ma["matchmaker_matched"]["token"]
+        assert mb["matchmaker_matched"]["token"]
+        await a.close()
+        await b.close()
+    finally:
+        await http.close()
+        await server.stop()
+
+
+async def test_js_module_load_errors_are_fatal(tmp_path):
+    from nakama_tpu.runtime import ModuleLoadError, load_runtime
+
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir()
+    (mod_dir / "bad.js").write_text("this is not js ===")
+    config = Config()
+    config.runtime.path = str(mod_dir)
+    with pytest.raises(ModuleLoadError):
+        load_runtime(quiet_logger(), config)
+
+    (mod_dir / "bad.js").write_text("var x = 1;")  # no InitModule
+    with pytest.raises(ModuleLoadError):
+        load_runtime(quiet_logger(), config)
+
+
+async def test_js_nk_bridge_breadth(tmp_path):
+    """The camelCase nk bridge drives real cores: accounts, groups,
+    leaderboards, wallet, notifications, channel — one rpc touching each
+    family, values crossing by conversion."""
+    mod_dir = tmp_path / "modules"
+    mod_dir.mkdir()
+    (mod_dir / "breadth.js").write_text(
+        """
+function InitModule(ctx, logger, nk, initializer) {
+    initializer.registerRpc("js_breadth", function(ctx, payload) {
+        var out = {};
+        var acct = nk.accountGetId(ctx.userId);
+        out.username = acct.user.username;
+        var g = nk.groupCreate(ctx.userId, "js-group", {open: true});
+        var groups = nk.groupsList({name: "js-group"});
+        out.group = groups.groups[0].name;
+        nk.leaderboardCreate("js-lb", {sort_order: "desc"});
+        nk.leaderboardRecordWrite("js-lb", ctx.userId, "u", 31);
+        var recs = nk.leaderboardRecordsList("js-lb");
+        out.score = recs.records[0].score;
+        var w = nk.walletUpdate(ctx.userId, {coins: 11});
+        out.coins = w[0].coins;
+        var digest = nk.sha256Hash("abc");
+        out.digest = digest.slice(0, 8);
+        out.b64 = nk.base64Encode("hi");
+        out.uuidLen = nk.uuidv4().length;
+        return JSON.stringify(out);
+    });
+}
+"""
+    )
+    config = Config()
+    config.socket.port = 0
+    config.runtime.path = str(mod_dir)
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    http = aiohttp.ClientSession()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        import base64
+
+        basic = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"defaultkey:").decode()
+        }
+        async with http.post(
+            f"{base}/v2/account/authenticate/device",
+            headers=basic,
+            json={"account": {"id": "js-device-breadth1"},
+                  "username": "jsbreadth"},
+        ) as r:
+            session = await r.json()
+        async with http.post(
+            f"{base}/v2/rpc/js_breadth",
+            headers={"Authorization": f"Bearer {session['token']}"},
+            data=json.dumps(""),
+        ) as r:
+            assert r.status == 200, await r.text()
+            out = json.loads((await r.json())["payload"])
+        assert out["username"] == "jsbreadth"
+        assert out["group"] == "js-group"
+        assert out["score"] == 31
+        assert out["coins"] == 11
+        import hashlib
+
+        assert out["digest"] == hashlib.sha256(b"abc").hexdigest()[:8]
+        assert out["b64"] == "aGk="
+        assert out["uuidLen"] == 36
+    finally:
+        await http.close()
+        await server.stop()
+
+
+def test_js_assignment_targets_evaluate_once():
+    # Regression (round-4 review): a[i++] += 10 double-evaluated the
+    # target (i bumped twice, write landed on the wrong element).
+    out, _ = run(
+        """
+        let i = 0;
+        let a = [1, 2];
+        a[i++] += 10;
+        console.log(JSON.stringify(a), i);
+        console.log([10, 20][1.5] === undefined);
+        console.log(parseInt("0x1f"), parseInt("ff", 16), parseInt("12px"));
+        console.log("5".padStart(6, "abc"), "5".padEnd(3, "-"));
+        """
+    )
+    assert out == [
+        "[11, 2] 1",
+        "true",
+        "31 255 12",
+        "abcab5 5--",
+    ]
+
+
+def test_js_padstart_burns_fuel():
+    with pytest.raises(JsFuelError):
+        run('"".padStart(100000000);', fuel=50_000)
+
+
+async def test_js_matchmaker_matched_hook_actually_runs(tmp_path):
+    # Regression (round-4 review): the matched wrapper had wrong arity
+    # (registry calls hooks as (ctx, entries)), so the guest hook
+    # silently never ran and the token fallback masked it. Returning a
+    # custom match id is only observable when the hook REALLY runs.
+    mod_dir = tmp_path / "modules"
+    mod_dir.mkdir()
+    (mod_dir / "m.js").write_text(
+        """
+function InitModule(ctx, logger, nk, initializer) {
+    initializer.registerMatchmakerMatched(function(ctx, entries) {
+        return "js-made-match." + entries.length;
+    });
+}
+"""
+    )
+    config = Config()
+    config.socket.port = 0
+    config.runtime.path = str(mod_dir)
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    http = aiohttp.ClientSession()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        import base64
+
+        basic = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"defaultkey:").decode()
+        }
+
+        async def ws_connect(device):
+            async with http.post(
+                f"{base}/v2/account/authenticate/device",
+                headers=basic, json={"account": {"id": device}},
+            ) as r:
+                tok = (await r.json())["token"]
+            return await websockets.connect(
+                f"ws://127.0.0.1:{server.port}/ws?token={tok}"
+            )
+
+        async def recv_key(ws, key, timeout=5.0):
+            while True:
+                e = json.loads(
+                    await asyncio.wait_for(ws.recv(), timeout=timeout)
+                )
+                if key in e:
+                    return e
+
+        a = await ws_connect("js-device-matched-1")
+        b = await ws_connect("js-device-matched-2")
+        for ws in (a, b):
+            await ws.send(json.dumps({
+                "cid": "mm",
+                "matchmaker_add": {
+                    "min_count": 2, "max_count": 2, "query": "*",
+                },
+            }))
+            await recv_key(ws, "matchmaker_ticket")
+        server.matchmaker.process()
+        ma = await recv_key(a, "matchmaker_matched")
+        assert ma["matchmaker_matched"]["match_id"] == "js-made-match.2"
+        await a.close()
+        await b.close()
+    finally:
+        await http.close()
+        await server.stop()

@@ -455,3 +455,73 @@ end, "bin")
     finally:
         await http.close()
         await server.stop(0)
+
+
+async def test_lua_matchmaker_matched_hook_actually_runs(tmp_path):
+    # Regression (round-4 review): the matched wrapper had wrong arity
+    # (registry calls hooks as (ctx, entries)) so guest matched hooks
+    # never ran; the token fallback masked it. A custom match id is only
+    # observable when the hook REALLY runs.
+    import aiohttp
+    import websockets as ws_lib
+
+    mod_dir = tmp_path / "modules"
+    mod_dir.mkdir()
+    (mod_dir / "m.lua").write_text(
+        """
+nk.register_matchmaker_matched(function(ctx, entries)
+    return "lua-made-match." .. tostring(#entries)
+end)
+"""
+    )
+    config = Config()
+    config.socket.port = 0
+    config.runtime.path = str(mod_dir)
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    http = aiohttp.ClientSession()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        import base64 as b64
+
+        basic = {
+            "Authorization": "Basic "
+            + b64.b64encode(b"defaultkey:").decode()
+        }
+
+        async def ws_connect(device):
+            async with http.post(
+                f"{base}/v2/account/authenticate/device",
+                headers=basic, json={"account": {"id": device}},
+            ) as r:
+                tok = (await r.json())["token"]
+            return await ws_lib.connect(
+                f"ws://127.0.0.1:{server.port}/ws?token={tok}"
+            )
+
+        async def recv_key(sock, key, timeout=5.0):
+            while True:
+                e = json.loads(
+                    await asyncio.wait_for(sock.recv(), timeout=timeout)
+                )
+                if key in e:
+                    return e
+
+        a = await ws_connect("lua-device-matched-1")
+        b = await ws_connect("lua-device-matched-2")
+        for sock in (a, b):
+            await sock.send(json.dumps({
+                "cid": "mm",
+                "matchmaker_add": {
+                    "min_count": 2, "max_count": 2, "query": "*",
+                },
+            }))
+            await recv_key(sock, "matchmaker_ticket")
+        server.matchmaker.process()
+        ma = await recv_key(a, "matchmaker_matched")
+        assert ma["matchmaker_matched"]["match_id"] == "lua-made-match.2"
+        await a.close()
+        await b.close()
+    finally:
+        await http.close()
+        await server.stop()
