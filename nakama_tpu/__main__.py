@@ -13,11 +13,14 @@ def _migrate(argv: list[str]) -> int:
     newest applied migration (downs are derived from the embedded up
     statements — storage/migrations.py down_statements)."""
     from .config import parse_args
-    from .storage.db import Database, migrate_status
+    from .storage import make_database
+    from .storage.db import migrate_status
 
     sub = argv[0] if argv else "status"
     config = parse_args(argv[1:])
-    db = Database((config.database.address or [":memory:"])[0])
+    # Engine chosen by DSN (a postgres:// address must migrate the
+    # Postgres server, not open a junk local file named like the DSN).
+    db = make_database((config.database.address or [":memory:"])[0])
 
     async def run():
         from .storage.migrations import MIGRATIONS

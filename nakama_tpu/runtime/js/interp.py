@@ -430,7 +430,20 @@ class Interp:
             return UNDEFINED
         if callable(fn):
             self.burn(4)
-            return fn(self, this, *args)
+            try:
+                return fn(self, this, *args)
+            except (
+                JsThrow, JsRuntimeError, _Break, _Continue, _Return
+            ):
+                raise
+            except (ValueError, OverflowError, ZeroDivisionError,
+                    TypeError) as e:
+                # Sandbox boundary: a host-level numeric/argument error
+                # from a stdlib builtin must surface as a guest-catchable
+                # exception, never escape as a raw Python error.
+                raise JsThrow(
+                    JSObject({"message": f"{type(e).__name__}: {e}"})
+                )
         raise JsRuntimeError(f"{_to_display(fn)} is not a function")
 
     # ------------------------------------------------------ member/index
@@ -560,10 +573,18 @@ class Interp:
 
     def unop(self, op, operand_node, env):
         if op == "typeof":
-            try:
+            if operand_node[0] == "name":
+                # typeof undeclaredName is "undefined", not an error —
+                # ONLY for a bare name; real errors inside a compound
+                # operand (null deref, fuel) must propagate.
+                try:
+                    v = self.eval(operand_node, env)
+                except JsFuelError:
+                    raise
+                except JsRuntimeError:
+                    return "undefined"
+            else:
                 v = self.eval(operand_node, env)
-            except JsRuntimeError:
-                return "undefined"  # typeof undeclared
             return _typeof(v)
         if op == "delete":
             if operand_node[0] == "member":
@@ -621,9 +642,13 @@ class Interp:
     def eval_assign(self, node, env):
         _, op, target, value_node = node
         ref = self._resolve_ref(target, env)
-        value = self.eval(value_node, env)
         if op != "=":
-            value = self.binop(op[:-1], self._ref_read(ref, env), value)
+            # JS order: the target's OLD value reads before the RHS runs
+            # (a += (a = 5, 2) is old_a + 2, not 7).
+            old = self._ref_read(ref, env)
+            value = self.binop(op[:-1], old, self.eval(value_node, env))
+        else:
+            value = self.eval(value_node, env)
         self._ref_write(ref, value, env)
         return value
 

@@ -369,17 +369,16 @@ class JsModule:
 
     def _register_hook(self, kind: str, fn, key):
         init = self.initializer
-        key_str = (
-            js_to_string(key).lower() if key is not None else None
-        )
-        if key_str and kind in (
-            "rt_before", "rt_after", "req_before", "req_after"
-        ):
-            # Reference JS API uses camelCase message names
-            # ("MatchmakerAdd"); the registry keys are snake_case.
-            key_str = re.sub(
-                r"(?<!^)(?=[A-Z])", "_", js_to_string(key)
-            ).lower()
+        # rt/req keys pass through RAW: the registry's _rt_key/_req_key
+        # already normalize camelCase ("MatchmakerAdd") and snake_case
+        # alike. Only rpc ids are plain lowercase identifiers.
+        key_str = None
+        if key is not None:
+            key_str = (
+                js_to_string(key).lower()
+                if kind == "rpc"
+                else js_to_string(key)
+            )
 
         if kind == "rpc":
             if not key_str:

@@ -157,7 +157,12 @@ def _arr_method(name):
 def _idx(v, length, default):
     if v is UNDEFINED or v is None:
         return default
-    i = int(_num(v))
+    f = _num(v)
+    if math.isnan(f):  # JS coerces NaN indices to 0
+        return 0
+    if math.isinf(f):
+        return length if f > 0 else 0
+    i = int(f)
     if i < 0:
         i = max(0, length + i)
     return min(i, length)
@@ -252,7 +257,8 @@ def _s_charcodeat(interp, s, i=UNDEFINED):
 
 @_str_method("repeat")
 def _s_repeat(interp, s, n=UNDEFINED):
-    count = int(_num(n))
+    f = _num(n)
+    count = 0 if math.isnan(f) else int(f)
     if count < 0:
         raise JsThrow(JSObject({"message": "invalid repeat count"}))
     interp.burn(count * max(1, len(s)) // 16 + 1)
@@ -573,7 +579,27 @@ def new_globals(print_fn=None) -> Env:
     )
 
     def _m1(fn):
-        return lambda interp, this, x=UNDEFINED: float(fn(_num(x)))
+        # JS math semantics: NaN/inf propagate as values; domain errors
+        # and overflow yield NaN/Infinity — never a host exception.
+        def call(interp, this, x=UNDEFINED):
+            v = _num(x)
+            if math.isnan(v):
+                return math.nan
+            try:
+                return float(fn(v))
+            except ValueError:
+                return math.nan
+            except OverflowError:
+                return math.inf if v > 0 else -math.inf
+
+        return call
+
+    def _js_log(x):
+        if x == 0:
+            return -math.inf
+        if x < 0:
+            raise ValueError("log domain")
+        return math.log(x)
 
     math_obj = JSObject(
         {
@@ -583,7 +609,7 @@ def new_globals(print_fn=None) -> Env:
             "trunc": _m1(math.trunc),
             "abs": _m1(abs),
             "sqrt": _m1(math.sqrt),
-            "log": _m1(math.log),
+            "log": _m1(_js_log),
             "exp": _m1(math.exp),
             "sign": _m1(lambda x: (x > 0) - (x < 0)),
             "min": lambda interp, this, *a: (
@@ -674,7 +700,14 @@ def new_globals(print_fn=None) -> Env:
         return float(sign * out) if seen else math.nan
 
     def parse_float(interp, this, s=UNDEFINED):
-        return _num(js_to_string(s))
+        import re as _re
+
+        # JS parseFloat: longest decimal prefix, never hex.
+        m = _re.match(
+            r"\s*[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?",
+            js_to_string(s),
+        )
+        return float(m.group(0)) if m else math.nan
 
     g.declare("parseInt", parse_int)
     g.declare("parseFloat", parse_float)

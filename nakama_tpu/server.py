@@ -48,12 +48,12 @@ class NakamaServer:
         # Persistence (reference DbConnect, main.go:129-133): constructed
         # here, connected in start(). `database=None` builds the embedded
         # engine from config.
-        from .storage import Database
+        from .storage import make_database
 
         self.db = database
         self._owns_db = database is None
         if self.db is None:
-            self.db = Database(
+            self.db = make_database(
                 config.database.address or [":memory:"],
                 read_pool_size=min(8, config.database.max_open_conns),
             )

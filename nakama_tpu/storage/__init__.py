@@ -1,17 +1,36 @@
-"""Persistence layer (L0): async SQLite database + embedded migrations.
+"""Persistence layer (L0): pluggable async engines + embedded migrations.
 
 The reference backs everything onto PostgreSQL/CockroachDB via pgx
 (reference server/db.go:35, migrate/sql/*.sql — 10 migrations, 17 tables).
-Our L0 is an embedded SQLite engine behind the same async seam the rest of
-the framework uses, so a Postgres driver can be swapped in later without
-touching the core domain services (SURVEY.md §7 stage 7).
+Two engines live behind one async seam:
+
+- `Database` (db.py): embedded SQLite — durable file or :memory:, WAL
+  read pool; the default and the test engine.
+- `PostgresDatabase` (pg.py): a shared Postgres service over a
+  stdlib-only wire-protocol client (the image bakes no pg driver).
+
+`make_database()` picks by DSN so config.database.address fully decides
+the engine (reference config.go's DSN does the same).
 """
 
 from .db import Database, DatabaseError, UniqueViolationError, migrate_status
+
+
+def make_database(addresses, read_pool_size: int = 4):
+    """Engine factory: postgres:// DSNs get the wire-protocol engine,
+    everything else the embedded SQLite engine."""
+    addrs = [addresses] if isinstance(addresses, str) else list(addresses)
+    if addrs and addrs[0].startswith(("postgres://", "postgresql://")):
+        from .pg import PostgresDatabase
+
+        return PostgresDatabase(addrs, read_pool_size=read_pool_size)
+    return Database(addrs, read_pool_size=read_pool_size)
+
 
 __all__ = [
     "Database",
     "DatabaseError",
     "UniqueViolationError",
+    "make_database",
     "migrate_status",
 ]

@@ -1,0 +1,315 @@
+"""In-process PostgreSQL wire-protocol fixture.
+
+Speaks enough of the v3 backend protocol to drive
+nakama_tpu/storage/pg.py end-to-end WITHOUT a real Postgres server
+(none exists in this image): startup, SCRAM-SHA-256 / md5 / cleartext
+auth (server side — a genuine mutual test of the client's SCRAM math),
+simple query, and the extended Parse/Bind/Describe/Execute/Sync flow.
+Statements execute against an in-memory SQLite connection ($n -> ?), so
+real core flows run through the real wire client against real SQL.
+
+Column type OIDs are inferred from the Python value types SQLite hands
+back, and unique-constraint failures surface as SQLSTATE 23505 — the
+two seams the engine's decode/error mapping depend on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import re
+import sqlite3
+import struct
+from base64 import b64decode, b64encode
+
+SCRAM_ITERATIONS = 4096
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\0"
+
+
+class FakePgServer:
+    def __init__(self, password="secret", auth="scram-sha-256"):
+        self.password = password
+        self.auth = auth
+        self.conn = sqlite3.connect(
+            ":memory:", check_same_thread=False, isolation_level=None
+        )  # autocommit: literal BEGIN/COMMIT/ROLLBACK work like PG
+        self.conn.execute("PRAGMA foreign_keys=ON")
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+        self.queries: list[str] = []
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._client, "127.0.0.1", 0
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self.conn.close()
+
+    # ------------------------------------------------------------- session
+
+    async def _client(self, r: asyncio.StreamReader, w: asyncio.StreamWriter):
+        try:
+            await self._handshake(r, w)
+            await self._serve(r, w)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            w.close()
+
+    async def _handshake(self, r, w):
+        (length,) = struct.unpack("!I", await r.readexactly(4))
+        body = await r.readexactly(length - 4)
+        (proto,) = struct.unpack("!I", body[:4])
+        assert proto == 196608, f"unexpected protocol {proto}"
+        params = body[4:].split(b"\0")
+        kv = dict(zip(params[0::2], params[1::2]))
+        user = kv.get(b"user", b"").decode()
+
+        if self.auth == "trust":
+            w.write(_msg(b"R", struct.pack("!I", 0)))
+        elif self.auth == "cleartext":
+            w.write(_msg(b"R", struct.pack("!I", 3)))
+            await w.drain()
+            tag, pw = await self._recv(r)
+            assert tag == b"p"
+            if pw.rstrip(b"\0").decode() != self.password:
+                await self._error(w, "28P01", "password authentication failed")
+                raise ConnectionError
+            w.write(_msg(b"R", struct.pack("!I", 0)))
+        elif self.auth == "md5":
+            salt = b"\x01\x02\x03\x04"
+            w.write(_msg(b"R", struct.pack("!I", 5) + salt))
+            await w.drain()
+            tag, pw = await self._recv(r)
+            inner = hashlib.md5(
+                (self.password + user).encode()
+            ).hexdigest()
+            want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+            if pw.rstrip(b"\0").decode() != want:
+                await self._error(w, "28P01", "password authentication failed")
+                raise ConnectionError
+            w.write(_msg(b"R", struct.pack("!I", 0)))
+        else:  # scram-sha-256
+            w.write(
+                _msg(
+                    b"R",
+                    struct.pack("!I", 10) + _cstr("SCRAM-SHA-256") + b"\0",
+                )
+            )
+            await w.drain()
+            tag, body = await self._recv(r)
+            assert tag == b"p"
+            mech_end = body.index(b"\0")
+            (ln,) = struct.unpack(
+                "!I", body[mech_end + 1 : mech_end + 5]
+            )
+            client_first = body[mech_end + 5 : mech_end + 5 + ln].decode()
+            first_bare = client_first.split(",", 2)[2]
+            client_nonce = dict(
+                p.split("=", 1) for p in first_bare.split(",")
+            )["r"]
+            salt = b"fixed-salt-0123"
+            nonce = client_nonce + "serverpart"
+            server_first = (
+                f"r={nonce},s={b64encode(salt).decode()},"
+                f"i={SCRAM_ITERATIONS}"
+            )
+            w.write(
+                _msg(
+                    b"R", struct.pack("!I", 11) + server_first.encode()
+                )
+            )
+            await w.drain()
+            tag, body = await self._recv(r)
+            client_final = body.decode()
+            parts = dict(
+                p.split("=", 1) for p in client_final.split(",")
+            )
+            final_nosig = client_final.rsplit(",p=", 1)[0]
+            auth_msg = ",".join([first_bare, server_first, final_nosig])
+            salted = hashlib.pbkdf2_hmac(
+                "sha256", self.password.encode(), salt, SCRAM_ITERATIONS
+            )
+            client_key = hmac.new(
+                salted, b"Client Key", hashlib.sha256
+            ).digest()
+            stored = hashlib.sha256(client_key).digest()
+            sig = hmac.new(
+                stored, auth_msg.encode(), hashlib.sha256
+            ).digest()
+            want_proof = bytes(
+                a ^ b for a, b in zip(client_key, sig)
+            )
+            if b64decode(parts["p"]) != want_proof:
+                await self._error(w, "28P01", "SCRAM proof mismatch")
+                raise ConnectionError
+            server_key = hmac.new(
+                salted, b"Server Key", hashlib.sha256
+            ).digest()
+            server_sig = b64encode(
+                hmac.new(
+                    server_key, auth_msg.encode(), hashlib.sha256
+                ).digest()
+            ).decode()
+            w.write(
+                _msg(
+                    b"R",
+                    struct.pack("!I", 12) + f"v={server_sig}".encode(),
+                )
+            )
+            w.write(_msg(b"R", struct.pack("!I", 0)))
+
+        w.write(_msg(b"S", _cstr("server_version") + _cstr("16.fixture")))
+        w.write(_msg(b"Z", b"I"))
+        await w.drain()
+
+    # -------------------------------------------------------------- queries
+
+    async def _serve(self, r, w):
+        stmt_sql = ""
+        bound: tuple = ()
+        while True:
+            tag, body = await self._recv(r)
+            if tag == b"X":
+                return
+            if tag == b"Q":
+                sql = body.rstrip(b"\0").decode()
+                self.queries.append(sql)
+                await self._run(w, sql, (), simple=True)
+                w.write(_msg(b"Z", b"I"))
+                await w.drain()
+            elif tag == b"P":
+                end = body.index(b"\0")
+                sql_end = body.index(b"\0", end + 1)
+                stmt_sql = body[end + 1 : sql_end].decode()
+                self.queries.append(stmt_sql)
+                w.write(_msg(b"1", b""))
+            elif tag == b"B":
+                off = body.index(b"\0") + 1
+                off = body.index(b"\0", off) + 1
+                (nfmt,) = struct.unpack("!H", body[off : off + 2])
+                off += 2 + nfmt * 2
+                (nparams,) = struct.unpack("!H", body[off : off + 2])
+                off += 2
+                params = []
+                for _ in range(nparams):
+                    (ln,) = struct.unpack("!i", body[off : off + 4])
+                    off += 4
+                    if ln < 0:
+                        params.append(None)
+                    else:
+                        params.append(body[off : off + ln])
+                        off += ln
+                bound = tuple(params)
+                w.write(_msg(b"2", b""))
+            elif tag == b"D":
+                pass  # description rides the Execute response
+            elif tag == b"E":
+                await self._run(w, stmt_sql, bound)
+            elif tag == b"S":
+                w.write(_msg(b"Z", b"I"))
+                await w.drain()
+            # others ignored
+
+    async def _run(self, w, sql, params, simple=False):
+        sqlite_sql = re.sub(r"\$(\d+)", "?", sql)
+        py_params = [self._coerce(sql, i, p) for i, p in enumerate(params)]
+        try:
+            cur = self.conn.execute(sqlite_sql, py_params)
+            rows = cur.fetchall() if cur.description else []
+        except sqlite3.IntegrityError as e:
+            code = (
+                "23505" if "UNIQUE constraint failed" in str(e) else "23000"
+            )
+            await self._error(w, code, str(e))
+            if simple:
+                w.write(_msg(b"Z", b"I"))
+                await w.drain()
+            return
+        except sqlite3.Error as e:
+            await self._error(w, "42601", str(e))
+            if simple:
+                w.write(_msg(b"Z", b"I"))
+                await w.drain()
+            return
+        if cur.description:
+            cols = [d[0] for d in cur.description]
+            oids = []
+            for i in range(len(cols)):
+                oid = 25  # text
+                for row in rows:
+                    v = row[i]
+                    if v is None:
+                        continue
+                    if isinstance(v, bool):
+                        oid = 16
+                    elif isinstance(v, int):
+                        oid = 20
+                    elif isinstance(v, float):
+                        oid = 701
+                    elif isinstance(v, (bytes, memoryview)):
+                        oid = 17
+                    break
+                oids.append(oid)
+            desc = struct.pack("!H", len(cols))
+            for name, oid in zip(cols, oids):
+                desc += _cstr(name) + struct.pack(
+                    "!IHIhih", 0, 0, oid, -1, -1, 0
+                )
+            w.write(_msg(b"T", desc))
+            for row in rows:
+                data = struct.pack("!H", len(row))
+                for v, oid in zip(row, oids):
+                    if v is None:
+                        data += struct.pack("!i", -1)
+                        continue
+                    if oid == 17:
+                        raw = b"\\x" + bytes(v).hex().encode()
+                    elif oid == 16:
+                        raw = b"t" if v else b"f"
+                    elif isinstance(v, float):
+                        raw = repr(v).encode()
+                    else:
+                        raw = str(v).encode()
+                    data += struct.pack("!I", len(raw)) + raw
+                w.write(_msg(b"D", data))
+        count = cur.rowcount if cur.rowcount >= 0 else len(rows)
+        verb = sqlite_sql.lstrip().split(" ", 1)[0].upper()
+        if verb == "INSERT":
+            w.write(_msg(b"C", _cstr(f"INSERT 0 {count}")))
+        else:
+            w.write(_msg(b"C", _cstr(f"{verb} {count}")))
+
+    def _coerce(self, sql, index, raw):
+        if raw is None:
+            return None
+        text = raw.decode()
+        if text.startswith("\\x"):
+            return bytes.fromhex(text[2:])
+        return text
+
+    async def _error(self, w, code, message):
+        body = (
+            b"S" + _cstr("ERROR") + b"C" + _cstr(code)
+            + b"M" + _cstr(message) + b"\0"
+        )
+        w.write(_msg(b"E", body))
+
+    async def _recv(self, r):
+        header = await r.readexactly(5)
+        (length,) = struct.unpack("!I", header[1:5])
+        return header[:1], await r.readexactly(length - 4)
