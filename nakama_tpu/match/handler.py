@@ -87,8 +87,24 @@ class MatchHandler:
 
     # ------------------------------------------------------------ lifecycle
 
-    def start(self):
-        self._task = asyncio.get_running_loop().create_task(self._run())
+    def start(self, loop: asyncio.AbstractEventLoop | None = None):
+        """Spawn the tick task. Callable off-loop (guest nk.match_create
+        runs match_init on a module worker thread): the task is then
+        scheduled onto the given loop thread-safely."""
+        if loop is None:
+            loop = asyncio.get_running_loop()
+        try:
+            on_loop = asyncio.get_running_loop() is loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            self._task = loop.create_task(self._run())
+        else:
+            loop.call_soon_threadsafe(
+                lambda: setattr(
+                    self, "_task", loop.create_task(self._run())
+                )
+            )
 
     async def _run(self):
         """The match goroutine equivalent (reference match_handler.go:179)."""

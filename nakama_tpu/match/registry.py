@@ -10,6 +10,7 @@ task with a timeout, route data, signal, and drain gracefully on shutdown.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 import uuid
@@ -65,6 +66,17 @@ class LocalMatchRegistry:
         factory = self._factories.get(handler_name.lower())
         if factory is None:
             raise MatchError(f"unknown match handler: {handler_name}")
+        # Thread-agnostic (guest nk.match_create runs on a module worker
+        # thread): match_init executes inline on the caller — guest
+        # module locks are reentrant per-thread — and the tick task
+        # schedules onto the server loop.
+        try:
+            loop = asyncio.get_running_loop()
+            self.loop = loop
+        except RuntimeError:
+            loop = getattr(self, "loop", None)
+            if loop is None or not loop.is_running():
+                raise MatchError("no event loop available for match tasks")
         match_id = f"{uuid.uuid4()}.{self.node}"
         core = factory()
         handler = MatchHandler(
@@ -80,7 +92,7 @@ class LocalMatchRegistry:
         )
         handler.create_time = time.time()
         self._handlers[match_id] = handler
-        handler.start()
+        handler.start(loop)
         if self.metrics:
             self.metrics.matches.set(len(self._handlers))
         return match_id

@@ -128,7 +128,9 @@ class JsModule:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"js-{name}"
         )
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # guest code can re-enter (an
+        # rpc calling nk.matchCreate runs the guest matchInit)
+        self._depth = threading.local()
         self._no_async = threading.local()
         self._loop: asyncio.AbstractEventLoop | None = None
         self.globals = new_globals(
@@ -166,9 +168,13 @@ class JsModule:
                 f"{INVOKE_TIMEOUT_SEC:.0f}s (a guest hook is likely"
                 " blocked on an async nakama call from a sync context)"
             )
+        depth = getattr(self._depth, "n", 0)
+        self._depth.n = depth + 1
+        prev_no_async = getattr(self._no_async, "flag", False)
         try:
-            self._no_async.flag = no_async
-            self.interp.fuel = FUEL_PER_INVOCATION
+            self._no_async.flag = no_async or prev_no_async
+            if depth == 0:  # nested invocations share the outer budget
+                self.interp.fuel = FUEL_PER_INVOCATION
             try:
                 return self.interp.call(fn, args)
             except JsThrow as e:
@@ -177,8 +183,37 @@ class JsModule:
                     e.value,
                 )
         finally:
-            self._no_async.flag = False
+            self._no_async.flag = prev_no_async
+            self._depth.n = depth
             self._lock.release()
+
+    def _call_sync(self, name, py_args, kwargs):
+        """Sync nk calls are loop-affine (match_create spawns tasks,
+        stream ops mutate loop-owned registries): from the module worker
+        thread they hop onto the event loop; on the loop (module load,
+        sync hooks) they run inline."""
+        fn = getattr(self.nk, name)
+        if name.startswith("match_"):
+            # Match ops are thread-agnostic (create_match runs
+            # match_init inline and schedules its task thread-safely) —
+            # and MUST stay on this thread: hopping to the loop while a
+            # guest invocation holds the module lock would deadlock a
+            # guest-registered match core's match_init.
+            return fn(*py_args, **kwargs)
+        try:
+            asyncio.get_running_loop()
+            on_loop = True
+        except RuntimeError:
+            on_loop = False
+        if on_loop or self._loop is None or not self._loop.is_running():
+            return fn(*py_args, **kwargs)
+
+        async def run():
+            return fn(*py_args, **kwargs)
+
+        return asyncio.run_coroutine_threadsafe(
+            run(), self._loop
+        ).result(INVOKE_TIMEOUT_SEC)
 
     def _await(self, coro):
         if getattr(self._no_async, "flag", False):
@@ -234,6 +269,70 @@ class JsModule:
         )
         return o
 
+    def _ctx_obj_dict(self, ctx) -> JSObject:
+        """Match-handler contexts are plain dicts ({match_id, node,
+        match_params}); camelCase them for the guest."""
+        if isinstance(ctx, dict):
+            o = JSObject()
+            for k, v in ctx.items():
+                o.set(_camel(k), to_js(v))
+            return o
+        return self._ctx_obj(ctx)
+
+    def _dispatcher_obj(self, dispatcher) -> JSObject:
+        o = getattr(dispatcher, "_js_obj", None)
+        if o is not None:
+            return o
+        from .stdlib import from_js as _from
+
+        def _resolve(presences):
+            """Guest presence dicts -> the handler's LIVE Presence
+            objects, matched by session id (guest values never carry
+            host references back)."""
+            wanted = {
+                p.get("session_id", "")
+                for p in (_from(presences) or [])
+                if isinstance(p, dict)
+            }
+            live = dispatcher._handler.presences.list()
+            return [p for p in live if p.id.session_id in wanted]
+
+        def broadcast(interp, this, op_code=UNDEFINED, data=UNDEFINED,
+                      presences=UNDEFINED, sender=UNDEFINED,
+                      reliable=True):
+            raw = (
+                js_to_string(data).encode("latin-1")
+                if data is not UNDEFINED
+                else b""
+            )
+            target = None
+            if presences is not UNDEFINED and presences is not None:
+                target = _resolve(presences)
+            dispatcher.broadcast_message(
+                int(_from(op_code) or 0), raw, target, None,
+                bool(reliable),
+            )
+            return UNDEFINED
+
+        def kick(interp, this, presences=UNDEFINED):
+            if presences is not UNDEFINED and presences is not None:
+                dispatcher.match_kick(_resolve(presences))
+            return UNDEFINED
+
+        def label_update(interp, this, label=UNDEFINED):
+            dispatcher.match_label_update(js_to_string(label))
+            return UNDEFINED
+
+        o = JSObject(
+            {
+                "broadcastMessage": broadcast,
+                "matchKick": kick,
+                "matchLabelUpdate": label_update,
+            }
+        )
+        dispatcher._js_obj = o
+        return o
+
     def _logger_obj(self) -> JSObject:
         o = JSObject()
         for level in ("debug", "info", "warn", "error"):
@@ -285,8 +384,10 @@ class JsModule:
                 py_args, kwargs = _convert_args(name, args)
                 try:
                     return _convert_out(
-                        getattr(module.nk, name)(*py_args, **kwargs)
+                        module._call_sync(name, py_args, kwargs)
                     )
+                except JsError:
+                    raise
                 except Exception as e:
                     raise JsThrow(JSObject({"message": str(e)}))
 
@@ -365,6 +466,32 @@ class JsModule:
                 return register
 
             o.set(js_name, make())
+
+        def register_match(interp, this, name=UNDEFINED, handler=UNDEFINED):
+            """registerMatch(name, {matchInit, matchJoinAttempt, ...}) —
+            reference JS match handlers (runtime_javascript.go). Accepts
+            the callback object directly or a factory function returning
+            one."""
+            if name is UNDEFINED or handler is UNDEFINED:
+                raise JsThrow(JSObject({
+                    "message": "registerMatch(name, handlers) expected"
+                }))
+            match_name = js_to_string(name)
+
+            def factory(_handler=handler):
+                obj = _handler
+                if not isinstance(obj, JSObject):
+                    obj = self._invoke(_handler, (), no_async=True)
+                if not isinstance(obj, JSObject):
+                    raise JsError(
+                        "registerMatch factory must yield a handler object"
+                    )
+                return GuestMatchCore(self, obj)
+
+            self.initializer.register_match(match_name, factory)
+            return UNDEFINED
+
+        o.set("registerMatch", register_match)
         return o
 
     def _register_hook(self, kind: str, fn, key):
@@ -536,3 +663,158 @@ def load_js_module(name, source, logger, nk, initializer) -> JsModule:
         from ..loader import ModuleLoadError
 
         raise ModuleLoadError(f"js module {name}: {e}") from e
+
+
+class GuestMatchCore:
+    """MatchCore adapter over a guest object of camelCase callbacks
+    (reference JS match handlers: initializer.registerMatch(name,
+    {matchInit, matchJoinAttempt, matchJoin, matchLeave, matchLoop,
+    matchTerminate, matchSignal}) — runtime_javascript.go match cores).
+
+    Guest state stays a RAW guest value threaded opaquely through the
+    match handler — it never converts per tick, so a 30-ticks/sec match
+    pays only the presences/messages conversion. Callbacks run with the
+    no-async posture (the tick loop lives on the event-loop thread)."""
+
+    def __init__(self, module: JsModule, obj):
+        self.module = module
+        self.obj = obj
+
+    def _fn(self, name):
+        from .stdlib import member_of
+
+        fn = member_of(self.module.interp, self.obj, name)
+        return None if fn is UNDEFINED else fn
+
+    def _call(self, name, args):
+        fn = self._fn(name)
+        if fn is None:
+            raise JsError(f"js match handler missing {name}")
+        return self.module._invoke(fn, args, no_async=True)
+
+    @staticmethod
+    def _presences(presences):
+        return to_js([p.as_dict() for p in presences])
+
+    def match_init(self, ctx, params):
+        out = self._call(
+            "matchInit", (self.module._ctx_obj_dict(ctx), to_js(params))
+        )
+        if not isinstance(out, JSObject):
+            raise JsError("matchInit must return {state, tickRate, label}")
+        tick = out.get("tickRate")
+        label = out.get("label")
+        return (
+            out.get("state"),
+            int(from_js(tick) or 1),
+            js_to_string(label) if label is not UNDEFINED else "",
+        )
+
+    def match_join_attempt(
+        self, ctx, dispatcher, tick, state, presence, metadata
+    ):
+        out = self._call(
+            "matchJoinAttempt",
+            (
+                self.module._ctx_obj_dict(ctx),
+                self.module._dispatcher_obj(dispatcher),
+                float(tick),
+                state,
+                to_js(presence.as_dict()),
+                to_js(metadata or {}),
+            ),
+        )
+        if out is None or out is UNDEFINED:
+            return state, False, ""
+        accept = out.get("accept")
+        reason = out.get("rejectMessage")
+        return (
+            out.get("state"),
+            bool(from_js(accept)),
+            js_to_string(reason) if reason is not UNDEFINED else "",
+        )
+
+    def _presence_cb(self, name, ctx, dispatcher, tick, state, presences):
+        out = self._call(
+            name,
+            (
+                self.module._ctx_obj_dict(ctx),
+                self.module._dispatcher_obj(dispatcher),
+                float(tick),
+                state,
+                self._presences(presences),
+            ),
+        )
+        if out is None or out is UNDEFINED:
+            return None
+        return out.get("state")
+
+    def match_join(self, ctx, dispatcher, tick, state, presences):
+        return self._presence_cb(
+            "matchJoin", ctx, dispatcher, tick, state, presences
+        )
+
+    def match_leave(self, ctx, dispatcher, tick, state, presences):
+        return self._presence_cb(
+            "matchLeave", ctx, dispatcher, tick, state, presences
+        )
+
+    def match_loop(self, ctx, dispatcher, tick, state, messages):
+        js_msgs = to_js(
+            [
+                {
+                    "sender": m.sender.as_dict(),
+                    "opCode": float(m.op_code),
+                    "data": m.data.decode("latin-1"),
+                    "reliable": m.reliable,
+                }
+                for m in messages
+            ]
+        )
+        out = self._call(
+            "matchLoop",
+            (
+                self.module._ctx_obj_dict(ctx),
+                self.module._dispatcher_obj(dispatcher),
+                float(tick),
+                state,
+                js_msgs,
+            ),
+        )
+        if out is None or out is UNDEFINED:
+            return None
+        return out.get("state")
+
+    def match_terminate(self, ctx, dispatcher, tick, state, grace_seconds):
+        out = self._call(
+            "matchTerminate",
+            (
+                self.module._ctx_obj_dict(ctx),
+                self.module._dispatcher_obj(dispatcher),
+                float(tick),
+                state,
+                float(grace_seconds),
+            ),
+        )
+        if out is None or out is UNDEFINED:
+            return None
+        return out.get("state")
+
+    def match_signal(self, ctx, dispatcher, tick, state, data):
+        out = self._call(
+            "matchSignal",
+            (
+                self.module._ctx_obj_dict(ctx),
+                self.module._dispatcher_obj(dispatcher),
+                float(tick),
+                state,
+                data,
+            ),
+        )
+        if out is None or out is UNDEFINED:
+            return state, ""
+        reply = out.get("data")
+        return (
+            out.get("state"),
+            js_to_string(reply) if reply is not UNDEFINED else "",
+        )

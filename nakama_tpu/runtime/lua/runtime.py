@@ -87,7 +87,9 @@ class LuaModule:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"lua-{name}"
         )
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # guest code can re-enter (an
+        # rpc calling nk.matchCreate runs the guest matchInit)
+        self._depth = threading.local()
         self._no_async = threading.local()
         self._loop: asyncio.AbstractEventLoop | None = None
         self.globals = new_globals(
@@ -115,13 +117,46 @@ class LuaModule:
                 f"{INVOKE_TIMEOUT_SEC:.0f}s (a guest hook is likely"
                 " blocked on an async nakama call from a sync context)"
             )
+        depth = getattr(self._depth, "n", 0)
+        self._depth.n = depth + 1
+        prev_no_async = getattr(self._no_async, "flag", False)
         try:
-            self._no_async.flag = no_async
-            self.interp.fuel = FUEL_PER_INVOCATION
+            self._no_async.flag = no_async or prev_no_async
+            if depth == 0:  # nested invocations share the outer budget
+                self.interp.fuel = FUEL_PER_INVOCATION
             return self.interp.call(fn, args)
         finally:
-            self._no_async.flag = False
+            self._no_async.flag = prev_no_async
+            self._depth.n = depth
             self._lock.release()
+
+    def _call_sync(self, name, py_args, kwargs):
+        """Sync nk calls are loop-affine (match_create spawns tasks,
+        stream ops mutate loop-owned registries): from the module worker
+        thread they hop onto the event loop; on the loop (module load,
+        sync hooks) they run inline."""
+        fn = getattr(self.nk, name)
+        if name.startswith("match_"):
+            # Match ops are thread-agnostic (create_match runs
+            # match_init inline and schedules its task thread-safely) —
+            # and MUST stay on this thread: hopping to the loop while a
+            # guest invocation holds the module lock would deadlock a
+            # guest-registered match core's match_init.
+            return fn(*py_args, **kwargs)
+        try:
+            asyncio.get_running_loop()
+            on_loop = True
+        except RuntimeError:
+            on_loop = False
+        if on_loop or self._loop is None or not self._loop.is_running():
+            return fn(*py_args, **kwargs)
+
+        async def run():
+            return fn(*py_args, **kwargs)
+
+        return asyncio.run_coroutine_threadsafe(
+            run(), self._loop
+        ).result(INVOKE_TIMEOUT_SEC)
 
     def _await(self, coro):
         """Bridge an async nk call from the Lua worker thread."""
@@ -253,7 +288,7 @@ class LuaModule:
             def call(interp, *args):
                 py_args, kwargs = _convert_args(name, args)
                 return _convert_out(
-                    getattr(module.nk, name)(*py_args, **kwargs)
+                    module._call_sync(name, py_args, kwargs)
                 )
 
             return call

@@ -224,6 +224,9 @@ class NakamaServer:
     # ------------------------------------------------------------ lifecycle
 
     async def start(self, port: int | None = None):
+        # Match tasks always land on this loop, even when create_match is
+        # driven from a guest-module worker thread.
+        self.match_registry.loop = asyncio.get_running_loop()
         if not self._db_connected:
             await self.db.connect()
             self._db_connected = True
