@@ -11,6 +11,8 @@ structured error rather than disconnecting.
 from __future__ import annotations
 
 import asyncio
+import base64
+import binascii
 import json
 from dataclasses import dataclass, field
 from typing import Any
@@ -20,6 +22,22 @@ from ..match.party import PartyError
 from ..metrics import Metrics
 from ..realtime import PresenceMeta, Stream, StreamMode
 from .envelope import REQUEST_KEYS, ErrorCode, error, message_key
+
+
+def _b64_bytes(data) -> bytes:
+    """Decode an envelope bytes field from its JSON representation.
+    The proto3 JSON mapping accepts both base64 alphabets (protobuf's
+    parser normalizes -_ to +/) and missing padding, so this does too."""
+    if isinstance(data, (bytes, bytearray)):
+        return bytes(data)
+    if not isinstance(data, str):
+        raise PipelineError("data must be a base64 string")
+    normalized = data.replace("-", "+").replace("_", "/")
+    normalized += "=" * (-len(normalized) % 4)
+    try:
+        return base64.b64decode(normalized, validate=True)
+    except (binascii.Error, ValueError) as e:
+        raise PipelineError("data must be base64") from e
 
 
 @dataclass
@@ -416,7 +434,14 @@ class Pipeline:
             session.send(out)
 
     def _h_match_data_send(self, session, cid, body):
-        """Reference pipeline_match.go:338-366."""
+        """Reference pipeline_match.go:338-366.
+
+        The envelope's `data` field is bytes (rtapi MatchDataSend.data,
+        both here and in the reference realtime.proto); in the JSON
+        representation bytes fields are base64 text per the proto3 JSON
+        mapping, which json_format applies when bridging protobuf-mode
+        sockets. The authoritative path decodes here so match cores see
+        raw bytes."""
         match_id = body.get("match_id", "")
         op_code = int(body.get("op_code", 0))
         data = body.get("data", "")
@@ -427,11 +452,12 @@ class Pipeline:
             presence = self.c.tracker.get_by_stream_user(stream, session.id)
             if presence is None:
                 raise PipelineError("not in match")
+            raw = _b64_bytes(data)
             registry.send_data(
                 match_id,
                 presence,
                 op_code,
-                data.encode() if isinstance(data, str) else data,
+                raw,
                 bool(body.get("reliable", True)),
             )
             return
@@ -439,12 +465,16 @@ class Pipeline:
         sender = self.c.tracker.get_by_stream_user(stream, session.id)
         if sender is None:
             raise PipelineError("not in match")
+        # Validate + canonicalize on the relayed path too: a non-base64
+        # payload relayed verbatim would blow up json_format.ParseDict
+        # (bytes field) in a protobuf-format recipient's writer and kill
+        # *their* socket.
         envelope = {
             "match_data": {
                 "match_id": match_id,
                 "presence": sender.as_dict(),
                 "op_code": op_code,
-                "data": data,
+                "data": base64.b64encode(_b64_bytes(data)).decode("ascii"),
             }
         }
         targets = [
@@ -644,10 +674,14 @@ class Pipeline:
         handler = self._party(body.get("party_id", ""))
 
         try:
+            # Same bytes-field contract as match data: validate and
+            # canonicalize the base64 before relaying to members.
             handler.data_send(
                 session.id,
                 int(body.get("op_code", 0)),
-                body.get("data", ""),
+                base64.b64encode(
+                    _b64_bytes(body.get("data", ""))
+                ).decode("ascii"),
             )
         except PartyError as e:
             raise PipelineError(str(e)) from e

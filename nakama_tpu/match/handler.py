@@ -12,6 +12,7 @@ markers expire un-completed joins.
 from __future__ import annotations
 
 import asyncio
+import base64
 import time
 from typing import Any
 
@@ -359,7 +360,19 @@ class MatchHandler:
         sender: Presence | None,
         reliable: bool,
     ):
-        payload = data.decode() if isinstance(data, bytes) else data
+        # Bytes fields ride the JSON envelope as base64 text (proto3
+        # JSON mapping of rtapi MatchData.data) — the protobuf-mode
+        # socket bridge base64-decodes this back to raw bytes.
+        if isinstance(data, str):
+            raw = data.encode("utf-8")
+        elif isinstance(data, (bytes, bytearray)):
+            raw = bytes(data)
+        else:
+            raise TypeError(
+                "broadcast data must be bytes or str, got"
+                f" {type(data).__name__}"
+            )
+        payload = base64.b64encode(raw).decode("ascii")
         envelope: dict = {
             "match_data": {
                 "match_id": self.match_id,

@@ -26,7 +26,13 @@ class JsRuntimeError(JsError):
     pass
 
 
-class JsFuelError(JsRuntimeError):
+class JsAbortError(JsRuntimeError):
+    """Aborts guest execution unconditionally — neither guest catch nor
+    guest finally runs during its unwind (the interpreter may no longer
+    be safe to execute on this thread, e.g. after module-lock loss)."""
+
+
+class JsFuelError(JsAbortError):
     """Budget exhaustion — deliberately NOT catchable by guest try/catch."""
 
 
@@ -270,29 +276,37 @@ class Interp:
             raise JsThrow(self.eval(node[1], env))
         elif kind == "try":
             _, body, catch_name, catch_body, finally_body = node
+            aborted = False
             try:
-                self.exec_stmt(body, env)
-            except JsThrow as e:
-                if catch_body is not None:
-                    catch_env = Env(env)
-                    if catch_name:
-                        catch_env.declare(catch_name, e.value)
-                    self.exec_stmt(catch_body, catch_env)
-                else:
-                    raise
-            except JsFuelError:
-                raise  # budget exhaustion is not guest-catchable
-            except JsRuntimeError as e:
-                if catch_body is not None:
-                    catch_env = Env(env)
-                    if catch_name:
-                        err_obj = JSObject({"message": str(e)})
-                        catch_env.declare(catch_name, err_obj)
-                    self.exec_stmt(catch_body, catch_env)
-                else:
-                    raise
+                try:
+                    self.exec_stmt(body, env)
+                except JsAbortError:
+                    raise  # fuel / lock loss: not guest-catchable
+                except JsThrow as e:
+                    if catch_body is not None:
+                        catch_env = Env(env)
+                        if catch_name:
+                            catch_env.declare(catch_name, e.value)
+                        self.exec_stmt(catch_body, catch_env)
+                    else:
+                        raise
+                except JsRuntimeError as e:
+                    if catch_body is not None:
+                        catch_env = Env(env)
+                        if catch_name:
+                            err_obj = JSObject({"message": str(e)})
+                            catch_env.declare(catch_name, err_obj)
+                        self.exec_stmt(catch_body, catch_env)
+                    else:
+                        raise
+            except JsAbortError:
+                # From the body or the catch handler: guest finally must
+                # not run either — the interpreter may be unsafe on this
+                # thread (lock loss) or out of budget.
+                aborted = True
+                raise
             finally:
-                if finally_body is not None:
+                if finally_body is not None and not aborted:
                     self.exec_stmt(finally_body, env)
         elif kind == "switch":
             _, disc_node, cases = node
@@ -579,7 +593,7 @@ class Interp:
                 # operand (null deref, fuel) must propagate.
                 try:
                     v = self.eval(operand_node, env)
-                except JsFuelError:
+                except JsAbortError:
                     raise
                 except JsRuntimeError:
                     return "undefined"

@@ -29,6 +29,7 @@ from .interp import (
     UNDEFINED,
     Env,
     Interp,
+    JsAbortError,
     JsError,
     JsRuntimeError,
     JsThrow,
@@ -185,7 +186,14 @@ class JsModule:
         finally:
             self._no_async.flag = prev_no_async
             self._depth.n = depth
-            self._lock.release()
+            lost = getattr(self._depth, "lost", 0)
+            if lost > 0:
+                # _unlocked_wait failed to reacquire: this frame's
+                # acquisition is already gone — don't release what the
+                # thread no longer owns.
+                self._depth.lost = lost - 1
+            else:
+                self._lock.release()
 
     def _call_sync(self, name, py_args, kwargs):
         """Sync nk calls are loop-affine (match_create spawns tasks,
@@ -211,9 +219,51 @@ class JsModule:
         async def run():
             return fn(*py_args, **kwargs)
 
-        return asyncio.run_coroutine_threadsafe(
-            run(), self._loop
-        ).result(INVOKE_TIMEOUT_SEC)
+        return self._unlocked_wait(
+            asyncio.run_coroutine_threadsafe(run(), self._loop)
+        )
+
+    def _unlocked_wait(self, future):
+        """Block on a cross-thread future with the module lock released.
+        The awaited loop-side work may re-enter guest code (e.g.
+        nk.matchSignal fires the match core's matchSignal callback,
+        which needs the interpreter); holding the lock across the wait
+        would deadlock until the invoke timeout. Semantically this is
+        an await point — other hooks may interleave, matching the
+        reference's per-concern goja VM pool (runtime_javascript.go),
+        where rpc and match code never share a VM at all."""
+        held = getattr(self._depth, "n", 0)
+        # Snapshot this invocation's fuel: an interleaved hook entering
+        # _invoke at thread-local depth 0 resets the shared interp.fuel,
+        # which would hand the suspended outer invocation a refill (or a
+        # deficit) when it resumes.
+        saved_fuel = self.interp.fuel if held else 0
+        for _ in range(held):
+            self._lock.release()
+        try:
+            return future.result(INVOKE_TIMEOUT_SEC)
+        finally:
+            # Only the first reacquire can block (RLock reacquisition by
+            # the owner always succeeds). If it times out, record the
+            # unowned acquisitions so the enclosing _invoke finallys skip
+            # their release() instead of masking this diagnostic with
+            # "cannot release un-acquired lock".
+            if held:
+                if self._lock.acquire(timeout=INVOKE_TIMEOUT_SEC):
+                    for _ in range(held - 1):
+                        self._lock.acquire()
+                    self.interp.fuel = saved_fuel
+                else:
+                    self._depth.lost = held
+                    # JsAbortError: guest catch/finally must NOT run —
+                    # this thread no longer owns the module lock, so
+                    # executing any further guest code would race the
+                    # invocation that does.
+                    raise JsAbortError(
+                        f"js module {self.name} wedged: could not"
+                        " reacquire the module lock after an async"
+                        " nakama call"
+                    )
 
     def _await(self, coro):
         if getattr(self._no_async, "flag", False):
@@ -234,9 +284,9 @@ class JsModule:
                 " not at module load time"
             )
         if self._loop is not None and self._loop.is_running():
-            return asyncio.run_coroutine_threadsafe(
-                coro, self._loop
-            ).result(INVOKE_TIMEOUT_SEC)
+            return self._unlocked_wait(
+                asyncio.run_coroutine_threadsafe(coro, self._loop)
+            )
         return asyncio.run(coro)
 
     def _ctx_obj(self, ctx) -> JSObject:

@@ -607,6 +607,9 @@ function InitModule(ctx, logger, nk, initializer) {
     initializer.registerRpc("make_match", function(ctx, payload) {
         return nk.matchCreate("jsarena", {});
     });
+    initializer.registerRpc("signal_match", function(ctx, payload) {
+        return nk.matchSignal(payload, "ping");
+    });
 }
 """
     )
@@ -664,7 +667,10 @@ function InitModule(ctx, logger, nk, initializer) {
             data=json.dumps(""),
         ) as r:
             assert r.status == 200, await r.text()
-            match_id = json.loads((await r.json())["payload"])
+            # Reference semantics (server/runtime_javascript.go rpc path):
+            # a JS rpc returning a string passes verbatim as the payload —
+            # nk.matchCreate's bare match id arrives unwrapped.
+            match_id = (await r.json())["payload"]
 
         assert server.match_registry.get(match_id).label == "js-arena"
 
@@ -682,6 +688,15 @@ function InitModule(ctx, logger, nk, initializer) {
         }))
         joined = await recv_key(a, "match")
         assert joined["match"]["match_id"] == match_id
+
+        # matchSignal round-trips through the JS core over the nk facade.
+        async with http.post(
+            f"{base}/v2/rpc/signal_match",
+            headers={"Authorization": f"Bearer {tok0}"},
+            data=json.dumps(match_id),
+        ) as r:
+            assert r.status == 200, await r.text()
+            assert (await r.json())["payload"] == "sig:ping"
 
         # Send data; the JS loop echoes via broadcastMessage.
         import base64 as b64mod

@@ -137,7 +137,12 @@ async def test_join_attempt_flow_and_data():
             e for e in alice.sent
             if "match_data" in e and e["match_data"]["op_code"] == 8
         ]
-        assert echoes and echoes[0]["match_data"]["data"] == "payload"
+        # Bytes ride the envelope as base64 (protobuf JSON mapping).
+        import base64 as _b64
+
+        assert echoes and _b64.b64decode(
+            echoes[0]["match_data"]["data"]
+        ) == b"payload"
 
         # Leave via untrack.
         tracker.untrack("sa", p.stream)

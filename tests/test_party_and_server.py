@@ -3,6 +3,7 @@ election/promotion/data relay/party matchmaking, driven through
 NakamaServer — the production wiring."""
 
 import asyncio
+import base64
 import json
 import time
 
@@ -80,11 +81,13 @@ async def test_party_full_lifecycle():
         await carol.recv("party")
         await asyncio.sleep(0.05)
         await alice.send(
-            {"party_data_send": {"party_id": pid, "op_code": 5, "data": "hi"}}
+            {"party_data_send": {"party_id": pid, "op_code": 5,
+                                 "data": base64.b64encode(b"hi").decode()}}
         )
         for c in (bob, carol):
             data = (await c.recv("party_data"))["party_data"]
-            assert data["op_code"] == 5 and data["data"] == "hi"
+            assert data["op_code"] == 5
+            assert base64.b64decode(data["data"]) == b"hi"
 
         # Non-leader cannot promote.
         await bob.send(
@@ -203,12 +206,13 @@ async def test_authoritative_match_over_socket():
                 "match_data_send": {
                     "match_id": mid,
                     "op_code": 9,
-                    "data": "whisper",
+                    # bytes fields are base64 on the JSON wire
+                    "data": base64.b64encode(b"whisper").decode(),
                 }
             }
         )
         echo = await alice.recv("match_data")
-        assert echo["match_data"]["data"] == "WHISPER"
+        assert base64.b64decode(echo["match_data"]["data"]) == b"WHISPER"
         assert echo["match_data"]["op_code"] == 9
         await alice.close()
     finally:
@@ -230,10 +234,11 @@ async def test_relayed_match_over_socket():
         assert {p["user_id"] for p in bmatch["presences"]} == {"ua"}
 
         await bob.send(
-            {"match_data_send": {"match_id": mid, "op_code": 3, "data": "yo"}}
+            {"match_data_send": {"match_id": mid, "op_code": 3,
+                                 "data": base64.b64encode(b"yo").decode()}}
         )
         got = await alice.recv("match_data")
-        assert got["match_data"]["data"] == "yo"
+        assert base64.b64decode(got["match_data"]["data"]) == b"yo"
         assert got["match_data"]["presence"]["user_id"] == "ub"
 
         # Sender must be in the match to send.
