@@ -21,9 +21,17 @@ import yaml
 @dataclass
 class LoggerConfig:
     level: str = "info"
-    format: str = "json"  # json | text
+    format: str = "json"  # json | text | logfmt | stackdriver
     stdout: bool = True
     file: str = ""
+    # File-sink rotation (reference server/config.go:627-646, lumberjack
+    # semantics): size-triggered rotation with count/age retention.
+    rotation: bool = False
+    max_size: int = 100  # megabytes before the file rotates
+    max_age: int = 0  # days to retain rotated files (0 = no age pruning)
+    max_backups: int = 0  # rotated files to retain (0 = keep all)
+    local_time: bool = False  # timestamp rotated names in local time
+    compress: bool = False  # gzip rotated files
 
 
 @dataclass

@@ -340,12 +340,15 @@ class LocalMatchmaker:
                     self.logger.error("gap flush error", error=str(e))
                 # Mid-gap delivery: ready cohorts ship NOW rather than
                 # at the next process() — at production cadence this
-                # takes a full interval_sec off add→matched. Two
-                # attempts spread over the remaining sleep so a slower
-                # device pass still delivers in-gap.
+                # takes a full interval_sec off add→matched. Poll at
+                # ~1s granularity (VERDICT r4 #3: a cohort becoming
+                # ready just after a sparse collection point used to
+                # wait for the next interval); collect_pipelined is a
+                # cheap no-op while nothing is ready.
                 rest = self.config.interval_sec - gap
-                for _ in range(2):
-                    await asyncio.sleep(rest / 2)
+                polls = max(2, int(rest))
+                for _ in range(polls):
+                    await asyncio.sleep(rest / polls)
                     if self._stopped or self._paused:
                         break
                     try:
@@ -493,6 +496,7 @@ class LocalMatchmaker:
         call. Per-entry Python objects are only touched on the override /
         host-only object paths."""
         t0 = time.perf_counter()
+        t_backend = t0  # re-stamped just before the backend call below
         store = self.store
         meta = store.meta
         active_slots = store.active_slots()
@@ -514,6 +518,7 @@ class LocalMatchmaker:
                 == meta["max_count"][active_slots]
             )
             expired_slots = active_slots[last]
+            t_backend = time.perf_counter()
             batch, matched_slots, reactivate = self.backend.process_slots(
                 active_slots,
                 last,
@@ -521,15 +526,20 @@ class LocalMatchmaker:
                 rev_precision=self.config.rev_precision,
             )
 
+        t_rm = time.perf_counter()
         store.deactivate(expired_slots)
+        t_rm1 = time.perf_counter()
         if len(matched_slots):
             self.backend.on_remove_slots(matched_slots)
+        t_rm2 = time.perf_counter()
+        if len(matched_slots):
             objs = store.remove_slots(matched_slots)
             if batch.offsets is not None:
                 # Columnar batch: its slots ARE matched_slots in order —
                 # reuse the parked refs as the delivery snapshot.
                 batch.bind_tickets(objs)
         store.reactivate(reactivate)
+        t_cb = time.perf_counter()
 
         if self.metrics is not None:
             self.metrics.mm_process_time.observe(time.perf_counter() - t0)
@@ -538,6 +548,29 @@ class LocalMatchmaker:
 
         if len(batch) and self.on_matched is not None:
             self.on_matched(batch)
+        # Attribute the post-backend tail (slot removal, delivery
+        # callback) on the interval's breadcrumb: the p99 work that
+        # isn't inside process_slots must still be visible to the bench
+        # (VERDICT r4 #2: per-pool breadcrumbs to attribute spikes).
+        # Override intervals never called process_slots, so the last
+        # crumb is some earlier interval's — updating it would corrupt
+        # that interval's attribution.
+        tracing = (
+            getattr(self.backend, "tracing", None)
+            if self.override_fn is None
+            else None
+        )
+        if tracing is not None and tracing.breadcrumbs:
+            import threading as _threading
+
+            tracing.breadcrumbs[-1].update(
+                remove_s=t_cb - t_rm,
+                rm_backend_s=t_rm2 - t_rm1,
+                rm_store_s=t_cb - t_rm2,
+                callback_s=time.perf_counter() - t_cb,
+                pre_backend_s=t_backend - t0,
+                threads=_threading.active_count(),
+            )
         return batch
 
     def _process_override(self, active_slots: np.ndarray):

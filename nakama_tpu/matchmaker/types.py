@@ -126,7 +126,10 @@ class MatchBatch:
         self._cache: dict[int, list[MatchmakerEntry]] = {}
 
     def bind_tickets(self, tickets_arr):
-        """Late-bind the ticket snapshot (aligned with `slots`)."""
+        """Late-bind the ticket snapshot (aligned with `slots`): either
+        the materialized object array, or a zero-arg resolver from the
+        store's lazy removal path — resolved on first entry access so
+        the O(entries) object gather stays off the interval."""
         if self._tickets is None:
             self._tickets = tickets_arr
 
@@ -154,6 +157,8 @@ class MatchBatch:
             raise IndexError(i)
         hit = self._cache.get(i)
         if hit is None:
+            if callable(self._tickets):
+                self._tickets = self._tickets()  # lazy store snapshot
             entries: list[MatchmakerEntry] = []
             for t in self._tickets[self.offsets[i] : self.offsets[i + 1]]:
                 entries.extend(t.entries)
@@ -187,6 +192,8 @@ class MatchBatch:
         """The ticket objects of match i (active ticket last)."""
         if self.offsets is None:
             raise ValueError("object-path batch has no slot data")
+        if callable(self._tickets):
+            self._tickets = self._tickets()  # lazy store snapshot
         return list(self._tickets[self.offsets[i] : self.offsets[i + 1]])
 
 

@@ -44,6 +44,40 @@ from .db import DatabaseError, UniqueViolationError
 from .migrations import MIGRATIONS
 
 
+def scram_client_final(
+    password: str,
+    first_bare: str,
+    server_first: str,
+    gs2_header: bytes = b"n,,",
+) -> tuple[str, str]:
+    """Pure SCRAM-SHA-256 client computation (RFC 5802/7677): given the
+    client-first-bare, the server-first message, and the password,
+    derive (client-final message, expected base64 server signature).
+    Factored out so RFC 7677's published exchange vectors pin it in
+    tests — real external ground truth for the auth math, independent
+    of this repo's own wire fixture."""
+    fields = dict(p.split("=", 1) for p in server_first.split(","))
+    r, s, i = fields["r"], fields["s"], int(fields["i"])
+    salted = hashlib.pbkdf2_hmac(
+        "sha256", password.encode(), b64decode(s), i
+    )
+    client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+    stored_key = hashlib.sha256(client_key).digest()
+    final_nosig = f"c={b64encode(gs2_header).decode()},r={r}"
+    auth_msg = ",".join([first_bare, server_first, final_nosig])
+    client_sig = hmac.new(
+        stored_key, auth_msg.encode(), hashlib.sha256
+    ).digest()
+    proof = b64encode(
+        bytes(a ^ b for a, b in zip(client_key, client_sig))
+    ).decode()
+    server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+    server_sig = b64encode(
+        hmac.new(server_key, auth_msg.encode(), hashlib.sha256).digest()
+    ).decode()
+    return f"{final_nosig},p={proof}", server_sig
+
+
 class PgProtocolError(DatabaseError):
     pass
 
@@ -172,25 +206,12 @@ class PgWireConnection:
             raise PgProtocolError("expected SASLContinue")
         server_first = body[4:].decode()
         fields = dict(p.split("=", 1) for p in server_first.split(","))
-        r, s, i = fields["r"], fields["s"], int(fields["i"])
-        if not r.startswith(nonce):
+        if not fields.get("r", "").startswith(nonce):
             raise PgProtocolError("server nonce mismatch")
-        salted = hashlib.pbkdf2_hmac(
-            "sha256", self.password.encode(), b64decode(s), i
+        client_final, expect = scram_client_final(
+            self.password, first_bare, server_first
         )
-        client_key = hmac.new(
-            salted, b"Client Key", hashlib.sha256
-        ).digest()
-        stored_key = hashlib.sha256(client_key).digest()
-        final_nosig = f"c={b64encode(b'n,,').decode()},r={r}"
-        auth_msg = ",".join([first_bare, server_first, final_nosig])
-        client_sig = hmac.new(
-            stored_key, auth_msg.encode(), hashlib.sha256
-        ).digest()
-        proof = b64encode(
-            bytes(a ^ b for a, b in zip(client_key, client_sig))
-        ).decode()
-        self._send(b"p", f"{final_nosig},p={proof}".encode())
+        self._send(b"p", client_final.encode())
         await self._drain_w()
 
         tag, body = await self._recv()
@@ -200,14 +221,6 @@ class PgWireConnection:
         if code != 12:  # SASLFinal
             raise PgProtocolError("expected SASLFinal")
         server_final = body[4:].decode()
-        server_key = hmac.new(
-            salted, b"Server Key", hashlib.sha256
-        ).digest()
-        expect = b64encode(
-            hmac.new(
-                server_key, auth_msg.encode(), hashlib.sha256
-            ).digest()
-        ).decode()
         got = dict(
             p.split("=", 1) for p in server_final.split(",")
         ).get("v", "")

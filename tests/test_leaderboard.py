@@ -150,9 +150,14 @@ def test_rank_cache_beats_skiplist_shape():
 # ----------------------------------------------------------- leaderboards
 
 
+from fixtures import db_engine_fixture, open_engine_db
+
+# Leaderboard core over BOTH db engines (VERDICT r4 #5).
+_engine = db_engine_fixture()
+
+
 async def make_lb():
-    db = Database(":memory:")
-    await db.connect()
+    db = await open_engine_db()
     lb = Leaderboards(quiet_logger(), db)
     await lb.load()
     return db, lb

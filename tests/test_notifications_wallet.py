@@ -19,9 +19,14 @@ from nakama_tpu.server import NakamaServer
 from nakama_tpu.storage.db import Database
 
 
+from fixtures import db_engine_fixture, open_engine_db
+
+# Wallet/notification cores over BOTH db engines (VERDICT r4 #5).
+_engine = db_engine_fixture()
+
+
 async def make_db(users=("ua", "ub")):
-    db = Database(":memory:")
-    await db.connect()
+    db = await open_engine_db()
     for uid in users:
         await db.execute(
             "INSERT INTO users (id, username, create_time, update_time)"

@@ -21,10 +21,14 @@ from nakama_tpu.core.storage import StorageError
 from nakama_tpu.storage import Database
 
 
+from fixtures import db_engine_fixture, open_engine_db
+
+# Run the whole OCC matrix over BOTH db engines (VERDICT r4 #5).
+_engine = db_engine_fixture()
+
+
 async def make_db():
-    db = Database(":memory:")
-    await db.connect()
-    return db
+    return await open_engine_db()
 
 
 SYSTEM = None  # system/runtime caller
