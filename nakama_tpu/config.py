@@ -112,22 +112,38 @@ class MatchmakerConfig:
     # collectives (SURVEY §2.8); capacity must split into col_block-sized
     # shards.
     mesh_devices: int = 0
-    # Pipelined intervals: process() collects the PREVIOUS interval's device
-    # results and dispatches the current one, hiding device+transfer latency
-    # entirely. Ticket properties are immutable so candidate eligibility
-    # cannot go stale; removed tickets are filtered at collection. Adds one
-    # interval of matching latency; off by default.
-    interval_pipelining: bool = False
-    # Device-side pair assignment: when intervals are synchronous
-    # (interval_pipelining off), the pool is large, and every live ticket
-    # is a solo 1v1 (min==max==2, count 1, multiple 1|2), grouping runs
-    # as a propose-accept handshake ON DEVICE (device2.pair_partners) and
-    # only the partner vector crosses D2H — the full candidate matrix
-    # (~16MB at 100k, the synchronous path's latency floor) never
-    # transfers. Matches stay exactly validated host-side; the matching
-    # is greedy-equivalent, not bit-identical to the sequential
-    # assembler's (oldest-first priority is preserved).
+    # Pipelined intervals — THE SHIPPED DEFAULT: process() dispatches the
+    # current interval's device pass and collects completed earlier ones,
+    # hiding device+transfer latency entirely (100k-pool Process p99 is
+    # ~20 ms pipelined vs ~1.5 s synchronous). Ticket properties are
+    # immutable so candidate eligibility cannot go stale; removed tickets
+    # are filtered at collection. A matched cohort delivers mid-gap as
+    # soon as its device pass + host assembly finish (normally seconds
+    # after dispatch), and every cohort carries a delivery deadline of
+    # one interval_sec: the interval loop preempts idle-gap work
+    # (GC/drain/flush) to block-join a cohort nearing its deadline, so a
+    # cohort is delivered before its own interval ends instead of
+    # slipping behind gap work. Set False for the synchronous reference
+    # semantics (same-interval delivery, device pass on the critical
+    # path) — kept as the explicit fallback and correctness oracle.
+    interval_pipelining: bool = True
+    # Device-side pair assignment: when the pool is large and every live
+    # ticket is a solo 1v1 (min==max==2, count 1, multiple 1|2),
+    # grouping runs as a propose-accept handshake ON DEVICE
+    # (device2.pair_partners) and only the partner vector crosses D2H —
+    # the full candidate matrix (~16MB at 100k) never transfers and the
+    # native greedy assembly never runs on the host. Synchronous
+    # intervals shed their latency floor this way; pipelined intervals
+    # shed the gap-side host assembly that contends with the server on
+    # small hosts (the cohort-slip tail). Matches stay exactly validated
+    # host-side; the matching is greedy-equivalent, not bit-identical to
+    # the sequential assembler's (oldest-first priority is preserved).
     device_pairing: bool = True
+    # Seconds before a pipelined cohort's delivery deadline at which the
+    # interval loop stops polling and block-joins the cohort's assembly
+    # (yielding the core to it). Bounds the worst-case delivery lag at
+    # interval_sec + this guard's overrun allowance.
+    pipeline_deadline_guard_sec: float = 2.0
     # Per-interval cap on host-only actives run through the CPU oracle
     # fallback (exotic queries the device kernel can't express). The
     # fallback is O(actives x pool) Python; without a cap a hostile or

@@ -100,7 +100,20 @@ class RotatingFile:
             else datetime.datetime.now(datetime.timezone.utc)
         )
         stamp = now.strftime("%Y-%m-%dT%H-%M-%S.%f")[:-3]
-        return f"{root}-{stamp}{ext}"
+        name = f"{root}-{stamp}{ext}"
+        # Millisecond stamps collide under same-millisecond rotations
+        # (tiny max_size + a burst of large lines); os.replace would then
+        # silently overwrite the earlier rotated file. De-collide with a
+        # monotonic sequence suffix (lumberjack-style uniqueness;
+        # retention order within the colliding millisecond is
+        # approximate, loss-free).
+        seq = 1
+        while os.path.exists(name) or (
+            self.compress and os.path.exists(name + ".gz")
+        ):
+            name = f"{root}-{stamp}.{seq}{ext}"
+            seq += 1
+        return name
 
     def _rotate(self):
         self._file.close()
@@ -148,7 +161,7 @@ class RotatingFile:
         # the same reason).
         stamp = re.compile(
             re.escape(base)
-            + r"-\d{4}-\d{2}-\d{2}T\d{2}-\d{2}-\d{2}\.\d{3}"
+            + r"-\d{4}-\d{2}-\d{2}T\d{2}-\d{2}-\d{2}\.\d{3}(\.\d+)?"
             + re.escape(ext)
             + r"(\.gz)?$"
         )
@@ -183,6 +196,18 @@ _LEVELS = {
     "warn": logging.WARNING,
     "warning": logging.WARNING,
     "error": logging.ERROR,
+}
+
+# Cloud Logging severity names (reference StackdriverLevelEncoder,
+# server/logger.go:188): 'WARN' is NOT a recognized LogSeverity — Cloud
+# Logging downgrades unknown names to DEFAULT, so warn lines would lose
+# their level. Map through this table, never name.upper().
+_STACKDRIVER_SEVERITY = {
+    "debug": "DEBUG",
+    "info": "INFO",
+    "warn": "WARNING",
+    "warning": "WARNING",
+    "error": "ERROR",
 }
 
 
@@ -225,7 +250,9 @@ class Logger:
             # zap's stackdriver encoder shape (reference logger.go:151-
             # 178): severity/timestamp/message keys, RFC3339 time.
             sd = {
-                "severity": name.upper(),
+                "severity": _STACKDRIVER_SEVERITY.get(
+                    name, name.upper()
+                ),
                 "timestamp": datetime.datetime.fromtimestamp(
                     record["ts"], datetime.timezone.utc
                 ).isoformat(),

@@ -699,9 +699,15 @@ def pair_partners(
     """Greedy 1v1 assignment entirely on device: parallel propose-accept
     rounds over the exact-ranked candidate lists, oldest-first priority.
 
-    Replaces the synchronous path's candidate-matrix D2H (the latency
-    floor: [A,k] i32 is ~16MB at a 100k pool) with a partner vector
-    (~0.5MB). Semantics per round:
+    Replaces the candidate-matrix D2H ([A,k] i32 is ~16MB at a 100k
+    pool) with a partner vector (~0.5MB) and removes the native greedy
+    assembly from the host entirely. Synchronous intervals shed their
+    latency floor this way; pipelined intervals (the shipped default)
+    shed the gap-side host work that contends with the server on small
+    hosts — the cohort-slip tail. Under pipelining the formed pairs flow
+    through the same queued-collect staleness masks (gen/alive/sel) as
+    assembler matches; a pair invalidated by churn drops and its members
+    reactivate. Semantics per round:
 
     - every open row proposes to a still-available candidate — its
       top-ranked one in round 0, pseudo-randomly diffused afterwards

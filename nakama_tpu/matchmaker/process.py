@@ -117,9 +117,14 @@ def process_default(
         if last_interval:
             expired_actives.append(active.ticket)
 
-        excluded = set(selected)
-        excluded.add(active.ticket)
-        hits = search_pool(active, pool, excluded)
+        # Exclude self by membership in `selected` for the duration of
+        # the search instead of copying the (growing) selected set per
+        # active — the copy was O(matched²) over an interval, real money
+        # on the budgeted host-only fallback at 100k pools. Removed
+        # below if no match forms; a formed match re-adds it anyway.
+        selected.add(active.ticket)
+        hits = search_pool(active, pool, selected)
+        matched_before = len(matched_entries)
 
         active_sessions = active.session_ids
         entry_combos: list[list[MatchmakerEntry]] = []
@@ -226,6 +231,11 @@ def process_default(
             for entry in current:
                 selected.add(entry.ticket)
             break
+
+        if len(matched_entries) == matched_before:
+            # No match formed: the self-exclusion entry must not shadow
+            # this ticket from later actives' searches.
+            selected.discard(active.ticket)
 
     return matched_entries, expired_actives
 

@@ -110,13 +110,15 @@ def run_chip_selfcheck(log=print) -> dict:
         )
         return _run(mm, specs, intervals)
 
-    # 1. Small-pool exact kernel: match-for-match oracle parity.
+    # 1. Small-pool exact kernel: match-for-match oracle parity
+    # (synchronous intervals — parity needs same-interval delivery).
     rng = np.random.default_rng(7)
     specs = _specs(rng, 96)
     cpu = cpu_matches(specs)
     cfg = MatchmakerConfig(
         pool_capacity=256, candidates_per_ticket=256, numeric_fields=8,
         string_fields=8, max_constraints=8, max_intervals=2,
+        interval_pipelining=False,
     )
     mm = LocalMatchmaker(
         test_logger(), cfg, backend=TpuBackend(cfg, test_logger())
@@ -127,7 +129,9 @@ def run_chip_selfcheck(log=print) -> dict:
     log(f"selfcheck small kernel: {results['small_exact_parity']} matches,"
         " exact oracle parity")
 
-    # 2. Big (two-stage MXU) kernel: exact validity + oracle coverage.
+    # 2. Big (two-stage MXU) kernel + native assembler: exact validity +
+    # oracle coverage (device_pairing off pins the assembler path — the
+    # pure-1v1 pool would otherwise take the pairing handshake).
     rng = np.random.default_rng(11)
     specs = _specs(rng, 600)
     cpu_total = _validate(cpu_matches(specs), specs, "oracle")
@@ -135,6 +139,7 @@ def run_chip_selfcheck(log=print) -> dict:
         pool_capacity=1024, candidates_per_ticket=64, numeric_fields=8,
         string_fields=8, max_constraints=8, max_intervals=2,
         big_pool_threshold=256, interval_pipelining=True,
+        device_pairing=False,
     )
     mm = LocalMatchmaker(
         test_logger(), cfg, backend=TpuBackend(
@@ -165,5 +170,26 @@ def run_chip_selfcheck(log=print) -> dict:
     assert pair_total >= cpu_total - 8, (pair_total, cpu_total)
     results["pairing_valid_entries"] = pair_total
     log(f"selfcheck device pairing: {pair_total} valid entries"
+        f" (oracle {cpu_total})")
+
+    # 4. Device pairing under PIPELINED intervals — the shipped default
+    # for pure-1v1 big pools: validity + coverage through the queued
+    # dispatch→collect flow (gen/alive/sel staleness masks included).
+    cfg = MatchmakerConfig(
+        pool_capacity=1024, candidates_per_ticket=64, numeric_fields=8,
+        string_fields=8, max_constraints=8, max_intervals=2,
+        big_pool_threshold=256, interval_pipelining=True,
+        device_pairing=True,
+    )
+    mm = LocalMatchmaker(
+        test_logger(), cfg, backend=TpuBackend(
+            cfg, test_logger(), big_row_block=256, big_col_block=256,
+        )
+    )
+    dev = _run(mm, specs, 3)
+    pipe_total = _validate(dev, specs, "pairs-pipelined")
+    assert pipe_total >= cpu_total - 8, (pipe_total, cpu_total)
+    results["pairing_pipelined_valid_entries"] = pipe_total
+    log(f"selfcheck pipelined device pairing: {pipe_total} valid entries"
         f" (oracle {cpu_total})")
     return results

@@ -244,9 +244,15 @@ class SlotStore:
             ticket_at = self.ticket_at
 
             def resolve(_h=holder, _t=ticket_at, _s=slots):
+                # Atomic/idempotent under concurrent callers: gather into
+                # a local, then publish with setdefault — first writer
+                # wins. A consumer racing drain() (which resolves before
+                # clearing ticket_at) can therefore never overwrite the
+                # valid cached array with a post-clear all-None gather.
                 objs = _h.get("objs")
                 if objs is None:
-                    _h["objs"] = objs = _t[_s]
+                    _h.setdefault("objs", _t[_s])
+                    objs = _h["objs"]
                 return objs
 
             self._graveyard.append((slots, resolve))
@@ -282,13 +288,26 @@ class SlotStore:
         self.remove_slots(np.asarray([slot], dtype=np.int32))
         return slot
 
-    def drain(self):
+    def drain(self, deadline: float | None = None):
         """Settle lazily-removed slots (reverse maps, object refs, free
         list) and release the parked objects; called from the interval
         idle gap, and on-demand when the allocator or a duplicate-id add
-        needs undrained slots settled early."""
+        needs undrained slots settled early.
+
+        `deadline` (perf_counter seconds) makes the pass preemptible: a
+        cohort delivery due mid-gap must not queue behind a ~100k-object
+        teardown, so the loop's gap work can stop between parked batches
+        and leave the rest for the next gap (each batch settles
+        atomically; partially-drained state is just a shorter
+        graveyard)."""
+        import time as _time
+
         parked, self._graveyard = self._graveyard, []
-        for slots, snapshot in parked:
+        for i, (slots, snapshot) in enumerate(parked):
+            if deadline is not None and _time.perf_counter() >= deadline:
+                # Park the remainder for the next gap (order preserved).
+                self._graveyard = parked[i:] + self._graveyard
+                return
             if callable(snapshot):
                 # Materialize any still-lazy delivery snapshot before the
                 # refs are cleared: a batch consumed after this drain

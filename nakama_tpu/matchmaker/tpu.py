@@ -84,6 +84,13 @@ def _work_ready(work: tuple) -> bool:
     return not work[0][-1].is_alive()
 
 
+def _work_deadline(work: tuple) -> float | None:
+    """The cohort's delivery deadline (perf_counter seconds): dispatch
+    time + one interval. Delivery past this point means the cohort
+    slipped its own interval."""
+    return work[0][1].get("deadline")
+
+
 class TpuBackend:
     """ProcessBackend implementation running on the JAX default device."""
 
@@ -559,7 +566,12 @@ class TpuBackend:
             # Oldest-first; stop at the first still-in-flight result to
             # keep collection ordered. Length > 2 forces a blocking drain
             # (backpressure) so a slow device can't grow the queue without
-            # bound.
+            # bound. An overdue-but-unfinished head is NOT force-popped
+            # here: process() runs on the event loop, and _collect's
+            # unbounded thread join would freeze the whole server behind
+            # a wedged fetch — the interval loop's deadline guard
+            # (bounded join_head in a worker thread, local.py) is the
+            # delivery path for overdue heads.
             while collectable > 0 and (
                 _work_ready(self._pipeline_queue[0])
                 or len(self._pipeline_queue) > 2
@@ -637,16 +649,73 @@ class TpuBackend:
         self.tracing.record(crumb)
         return batch, matched_slots, reactivate
 
-    def collect_ready(self, *, rev_precision: bool):
+    # ----------------------------------------------- pipeline state surface
+
+    def next_deadline(self) -> float | None:
+        """Earliest delivery deadline among queued cohorts (perf_counter
+        seconds), or None when nothing is in flight. The interval loop
+        schedules its gap wakes around this."""
+        if not self._pipeline_queue:
+            return None
+        return _work_deadline(self._pipeline_queue[0])
+
+    def pipeline_depth(self) -> int:
+        return len(self._pipeline_queue)
+
+    def pipeline_backlogged(self) -> bool:
+        """True under genuine pipeline pressure — an unfinished head
+        cohort that either already has a newer cohort stacked behind it
+        (it survived a whole interval) or is close to its delivery
+        deadline. The interval loop sheds its idle-gap work (GC pass,
+        store drain, flush) for that gap instead of making the cohort's
+        fetch/assembly thread queue behind it on a contended core. A
+        head merely in normal mid-gap flight (seconds old, deadline far)
+        does NOT shed: that would starve maintenance most intervals and
+        then dump the accumulated churn into one still-backlogged gap."""
+        if not self._pipeline_queue or _work_ready(self._pipeline_queue[0]):
+            return False
+        if len(self._pipeline_queue) > 1:
+            return True
+        deadline = _work_deadline(self._pipeline_queue[0])
+        if deadline is None:
+            return False
+        import time as _time
+
+        guard = max(
+            0.1, float(self.config.pipeline_deadline_guard_sec)
+        )
+        return _time.perf_counter() >= deadline - 2.0 * guard
+
+    def join_head(self, until: float) -> bool:
+        """Block (yielding the GIL — and with it the core — to the
+        cohort's worker thread) until the head cohort's assembly
+        finishes or `until` (perf_counter seconds) passes. Returns
+        readiness. The deadline guard's last resort: on a contended host
+        the join IS the preemption that lets the cohort finish."""
+        if not self._pipeline_queue:
+            return False
+        import time as _time
+
+        head = self._pipeline_queue[0]
+        head[0][-1].join(max(0.0, until - _time.perf_counter()))
+        return _work_ready(head)
+
+    def collect_ready(self, *, rev_precision: bool, block_until=None):
         """Drain completed pipelined cohorts OUTSIDE process(): the
         interval loop calls this mid-gap, so a cohort delivers as soon as
         its device pass + gap assembly finish (~seconds into the gap)
         instead of waiting for the NEXT interval — cutting a full
         interval_sec off add→matched latency at production cadence. Same
-        accept path, no new dispatch. Returns (batch, matched_slots,
-        reactivate) or None when nothing is ready."""
+        accept path, no new dispatch. `block_until` (perf_counter
+        seconds) bounds a blocking join of the head cohort — the
+        deadline guard passes it so a cohort nearing its delivery
+        deadline ships now instead of waiting out another poll. Returns
+        (batch, matched_slots, reactivate) or None when nothing is
+        ready."""
         if not self._pipeline_queue:
             return None
+        if block_until is not None:
+            self.join_head(block_until)
         ready_works: list[tuple] = []
         while self._pipeline_queue and _work_ready(self._pipeline_queue[0]):
             ready_works.append(self._pipeline_queue.popleft())
@@ -708,20 +777,50 @@ class TpuBackend:
 
             now = _time.perf_counter()
             ready_lag = (holder.get("t_ready", now)) - t_disp
+            fetch_lag = (holder.get("t_fetched", now)) - t_disp
             collect_lag = now - t_disp
+            deadline = holder.get("deadline")
+            slipped = (
+                pipelined and deadline is not None and now > deadline
+            )
             crumb.setdefault("cohort_ready_lag_ms", []).append(
                 round(ready_lag * 1000, 1)
+            )
+            crumb.setdefault("cohort_fetch_lag_ms", []).append(
+                round(fetch_lag * 1000, 1)
             )
             crumb.setdefault("cohort_collect_lag_ms", []).append(
                 round(collect_lag * 1000, 1)
             )
-            interval_sec = self.config.interval_sec
-            if pipelined and interval_sec and collect_lag > interval_sec:
+            if slipped:
+                crumb["cohort_slipped"] = crumb.get("cohort_slipped", 0) + 1
+            # Per-cohort dispatch→delivered ledger: slips are read off
+            # the console/metrics, not inferred from bench WARN lines.
+            # Pipelined cohorts only — the synchronous fallback's
+            # blocking same-interval collects would otherwise pollute
+            # the delivery-lag histogram and evict real pipelined
+            # entries from the ledger window slip_count() reads.
+            if pipelined:
+                self.tracing.record_delivery(
+                    ready_lag_s=round(ready_lag, 3),
+                    fetch_lag_s=round(fetch_lag, 3),
+                    collect_lag_s=round(collect_lag, 3),
+                    slipped=bool(slipped),
+                )
+                if self.metrics is not None:
+                    self.metrics.mm_delivery_lag.observe(collect_lag)
+                    if slipped:
+                        self.metrics.mm_cohort_slipped.inc()
+            if slipped:
+                # Attribution in the message itself: a long fetch_lag
+                # names the D2H transfer; ready≈fetch with a long
+                # collect names gap-poll gating.
                 self.logger.warn(
-                    "cohort missed every mid-gap collection point",
+                    "cohort delivered past its interval deadline",
                     ready_lag_s=round(ready_lag, 2),
+                    fetch_lag_s=round(fetch_lag, 2),
                     collect_lag_s=round(collect_lag, 2),
-                    interval_sec=interval_sec,
+                    interval_sec=self.config.interval_sec,
                 )
         with span(crumb, "accept_s"):
             total = int(offsets[n_matches])
@@ -1010,13 +1109,16 @@ class TpuBackend:
         return self._bg_asm("small", (scores, cand), slots, last, rev)
 
     def _use_pairs(self) -> bool:
-        """Device-side 1v1 grouping is eligible when configured, the
-        interval is synchronous, and the whole pool is pure 1v1 — one
-        predicate for the single-chip and mesh dispatch paths."""
+        """Device-side 1v1 grouping is eligible when configured and the
+        whole pool is pure 1v1 — one predicate for the single-chip and
+        mesh dispatch paths. Synchronous intervals shed the candidate
+        matrix D2H (their latency floor); pipelined intervals shed the
+        gap-side host work (16MB fetch + native assembly) that contends
+        with the server on small hosts — the cohort-slip tail. Staleness
+        semantics are identical either way: pairs flow through the same
+        gen/alive/sel accept masks as assembler matches."""
         return (
-            self.config.device_pairing
-            and not self.config.interval_pipelining
-            and self._nonpair_count == 0
+            self.config.device_pairing and self._nonpair_count == 0
         )
 
     def _pairs_dispatch(self, cand_dev, slots, a_pad, last, rev):
@@ -1060,7 +1162,14 @@ class TpuBackend:
         np.asarray pays the full transfer."""
         import time as _time
 
-        holder: dict = {"t_dispatch": _time.perf_counter()}
+        t_disp = _time.perf_counter()
+        holder: dict = {
+            "t_dispatch": t_disp,
+            # Delivery deadline: the cohort must reach players before its
+            # OWN interval ends. collect_ready preempts gap work for a
+            # cohort nearing this stamp (local.py deadline guard).
+            "deadline": t_disp + max(1.0, float(self.config.interval_sec)),
+        }
         n_rows = len(slots)
 
         def _run(out=holder):
@@ -1072,6 +1181,7 @@ class TpuBackend:
                     proposer = np.ascontiguousarray(
                         np.asarray(dev_arrays[1])
                     )[:n_rows]
+                    out["t_fetched"] = _time.perf_counter()
                     out["asm"] = self._assemble_pairs(
                         slots, partner, proposer, rev
                     )
@@ -1083,6 +1193,7 @@ class TpuBackend:
                     cand_np = np.ascontiguousarray(
                         np.asarray(dev_arrays[0])
                     )[:n_rows]
+                    out["t_fetched"] = _time.perf_counter()
                 else:
                     scores_np = np.ascontiguousarray(
                         np.asarray(dev_arrays[0])
@@ -1090,6 +1201,7 @@ class TpuBackend:
                     cand_np = np.ascontiguousarray(
                         np.asarray(dev_arrays[1])
                     )[:n_rows]
+                    out["t_fetched"] = _time.perf_counter()
                     cand_np = self._order_small(scores_np, cand_np)
                 out["asm"] = self._assemble(slots, last, cand_np, rev)
             except Exception as e:  # surfaced at collect

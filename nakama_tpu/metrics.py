@@ -71,6 +71,28 @@ class Metrics:
         self.mm_device_time = histo(
             "matchmaker_device_time_sec", "TPU kernel time inside Process()"
         )
+        # Pipelined delivery observability: per-cohort dispatch→delivered
+        # lag (bucketed to interval scale, not the RPC-latency grid), a
+        # loud counter for cohorts delivered past their own interval
+        # deadline (the slip the bench gates on), and the gaps whose
+        # GC/drain/flush work was shed under pipeline backpressure.
+        self.mm_delivery_lag = Histogram(
+            "matchmaker_delivery_lag_sec",
+            "Pipelined cohort dispatch→delivered lag",
+            (),
+            namespace=ns,
+            registry=self.registry,
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0),
+        )
+        self.mm_cohort_slipped = counter(
+            "matchmaker_cohort_slipped",
+            "Cohorts delivered past their own interval deadline",
+        )
+        self.mm_gap_shed = counter(
+            "matchmaker_gap_work_shed",
+            "Interval gaps whose GC/drain/flush were shed under pipeline "
+            "backpressure",
+        )
 
         # Message routing / presence events.
         self.outgoing_dropped = counter(

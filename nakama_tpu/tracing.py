@@ -25,6 +25,10 @@ class Tracing:
         self.logger = logger
         self._profiler_started = False
         self.breadcrumbs: deque[dict] = deque(maxlen=capacity)
+        # Per-cohort pipelined delivery ledger (dispatch→delivered lag,
+        # deadline slips): slips are observable here and via metrics,
+        # not inferred from bench WARN lines.
+        self.deliveries: deque[dict] = deque(maxlen=capacity)
         if port:
             self.start_profiler_server(port)
 
@@ -70,3 +74,20 @@ class Tracing:
 
     def recent(self, n: int = 32) -> list[dict]:
         return list(self.breadcrumbs)[-n:]
+
+    # -------------------------------------------------- cohort deliveries
+
+    def record_delivery(self, **fields):
+        """One pipelined cohort delivered: lag attribution + slip flag
+        (tpu.py accept path). Kept separate from interval breadcrumbs so
+        mid-gap deliveries don't dilute per-interval timing rows."""
+        fields.setdefault("ts", time.time())
+        self.deliveries.append(fields)
+
+    def recent_deliveries(self, n: int = 32) -> list[dict]:
+        return list(self.deliveries)[-n:]
+
+    def slip_count(self) -> int:
+        """Deliveries in the retained window that missed their cohort's
+        interval deadline."""
+        return sum(1 for d in self.deliveries if d.get("slipped"))

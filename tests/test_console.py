@@ -187,6 +187,10 @@ async def test_console_matchmaker_breadcrumbs():
     config.socket.port = 0
     config.matchmaker.pool_capacity = 4096
     config.matchmaker.big_pool_threshold = 1 << 30  # small exact kernel
+    # Synchronous interval: the breadcrumb assertions below need one
+    # process() to dispatch AND deliver (the pipelined default delivers
+    # mid-gap, one interval later).
+    config.matchmaker.interval_pipelining = False
     server = NakamaServer(config, quiet_logger())
     backend = TpuBackend(config.matchmaker, quiet_logger())
     server.matchmaker.backend = backend

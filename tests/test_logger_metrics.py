@@ -67,7 +67,10 @@ def test_logfmt_and_stackdriver_formats():
         "careful", detail=1
     )
     rec = json.loads(buf.getvalue())
-    assert rec["severity"] == "WARN"
+    # Cloud Logging's LogSeverity enum has WARNING, not WARN — an
+    # unknown name is downgraded to DEFAULT (ADVICE r5 #1; reference
+    # StackdriverLevelEncoder, server/logger.go:188).
+    assert rec["severity"] == "WARNING"
     assert rec["message"] == "careful"
     assert rec["detail"] == 1
     assert rec["timestamp"].endswith("+00:00")

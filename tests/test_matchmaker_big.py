@@ -19,6 +19,10 @@ from test_matchmaker_tpu import (  # reuse fixtures/validators
 
 
 def make_big_mm(**kw):
+    # Matching-semantics tests pin the synchronous path (one
+    # process() == one delivered interval); the pipelined shipped
+    # default is covered by test_matchmaker_cadence.py.
+    kw.setdefault("interval_pipelining", False)
     cfg = MatchmakerConfig(
         pool_capacity=2048,
         candidates_per_ticket=32,

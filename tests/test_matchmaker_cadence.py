@@ -1,0 +1,202 @@
+"""Pipelined interval-loop delivery guarantees (the shipped default).
+
+The production posture is `interval_pipelining=True`: process() dispatches
+the device pass and a cohort delivers mid-gap, with a hard delivery
+deadline of one interval_sec from dispatch. These tests drive the REAL
+asyncio interval loop at a short interval (the ISSUE's deterministic
+short-interval variant of a fake clock) and assert:
+
+- the default config actually ships the pipelined path,
+- every dispatched cohort is delivered BEFORE its own interval deadline
+  across >= 3 cohorts (the cohort-slip tail the round-5 VERDICT flagged:
+  34s maxima at a 15s cadence),
+- the deadline guard (bounded head-join) and the delivery ledger
+  (tracing.deliveries / slip metrics) observe what happened.
+"""
+
+import asyncio
+import logging
+import time
+
+from nakama_tpu.config import MatchmakerConfig
+from nakama_tpu.logger import test_logger as quiet_logger
+from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+from nakama_tpu.matchmaker.tpu import TpuBackend
+from nakama_tpu.metrics import Metrics
+
+_uid = 0
+
+
+def _presence():
+    global _uid
+    _uid += 1
+    return MatchmakerPresence(
+        user_id=f"cad-u{_uid}", session_id=f"cad-s{_uid}"
+    )
+
+
+def _add_pair(mm, mode):
+    for _ in range(2):
+        p = _presence()
+        mm.add(
+            [p], p.session_id, "", f"properties.mode:{mode}", 2, 2, 1,
+            {"mode": mode}, {},
+        )
+
+
+def _mk(**kw):
+    defaults = dict(
+        pool_capacity=256,
+        candidates_per_ticket=64,
+        numeric_fields=8,
+        string_fields=8,
+        max_constraints=8,
+        max_intervals=99,
+    )
+    defaults.update(kw)
+    cfg = MatchmakerConfig(**defaults)
+    got = []
+    metrics = Metrics(namespace="cadence")  # private registry per instance
+    backend = TpuBackend(
+        cfg, quiet_logger(), metrics, row_block=8, col_block=64
+    )
+    mm = LocalMatchmaker(
+        quiet_logger(), cfg, metrics=metrics, backend=backend,
+        on_matched=got.append,
+    )
+    return mm, got, backend, metrics
+
+
+def test_default_config_ships_pipelined_path():
+    """The default MatchmakerConfig runs the pipelined dispatch→collect
+    flow: pipelining on, and a TpuBackend under an unmodified default
+    flag queues its dispatch instead of delivering same-interval."""
+    assert MatchmakerConfig().interval_pipelining is True
+    # Unpinned flag → dataclass default → the pipelined path.
+    mm, got, backend, _ = _mk()
+    assert mm.config.interval_pipelining is True
+    _add_pair(mm, "a")
+    mm.process()
+    assert backend.pipeline_depth() == 1  # dispatched, queued
+    assert not got  # pipelined: no same-interval delivery
+    backend.wait_idle(30)
+    assert mm.collect_pipelined() is not None
+    assert len(got) == 1 and len(got[0][0]) == 2
+
+
+def test_cohorts_deliver_before_their_interval_deadline():
+    """>= 3 cohorts through the REAL interval loop at a short cadence:
+    every cohort must be delivered before its own interval deadline (no
+    slip), via the loop's mid-gap collection + deadline guard."""
+    interval = 2
+    mm, got, backend, metrics = _mk(
+        interval_sec=interval, pipeline_deadline_guard_sec=0.5
+    )
+
+    async def drive():
+        mm.start()
+        try:
+            for cycle in range(3):
+                _add_pair(mm, f"c{cycle}")
+                await asyncio.sleep(interval)
+            # Tail: the last cohort's delivery deadline is one interval
+            # after its dispatch.
+            await asyncio.sleep(interval + 0.5)
+        finally:
+            mm.stop()
+
+    asyncio.run(drive())
+    deliveries = backend.tracing.recent_deliveries(100)
+    assert len(deliveries) >= 3, deliveries
+    slipped = [d for d in deliveries if d["slipped"]]
+    assert not slipped, deliveries
+    assert all(
+        d["collect_lag_s"] <= interval for d in deliveries
+    ), deliveries
+    assert backend.tracing.slip_count() == 0
+    # Every pair actually reached the callback (3 cohorts x 2 entries).
+    total = sum(len(es) for batch in got for es in batch)
+    assert total == 6, total
+
+
+def test_loop_sheds_gap_work_under_backpressure():
+    """Genuine backlog — a cohort whose assembly outlives its whole
+    interval while the next interval dispatches behind it — must make
+    the loop shed its GC/drain/flush gap work (delivery preempts
+    maintenance), observable on the metrics counter; and the ledger
+    must record the slow cohort's late delivery as slipped instead of
+    hiding it. A head in normal mid-gap flight does NOT shed (the
+    healthy deliveries in the cadence test above run maintenance every
+    interval)."""
+    interval = 0.5
+    mm, got, backend, metrics = _mk(
+        interval_sec=interval, pipeline_deadline_guard_sec=0.2
+    )
+    # Worker slower than the interval: each cohort survives into the
+    # next interval's dispatch, stacking two unfinished cohorts.
+    orig = backend._assemble
+
+    def slow_assemble(*a, **kw):
+        time.sleep(2.0)
+        return orig(*a, **kw)
+
+    backend._assemble = slow_assemble
+
+    async def drive():
+        mm.start()
+        try:
+            for cycle in range(3):
+                # Offset adds to mid-interval so each cohort lands in
+                # its own dispatch (no add/process boundary race).
+                await asyncio.sleep(0.2 if cycle == 0 else interval)
+                _add_pair(mm, f"s{cycle}")
+            await asyncio.sleep(3.5)
+        finally:
+            mm.stop()
+
+    asyncio.run(drive())
+    shed = metrics.snapshot().get(
+        "cadence_matchmaker_gap_work_shed_total", 0.0
+    )
+    assert shed >= 1, metrics.snapshot()
+    # The artificially slowed cohorts delivered past their deadlines —
+    # and the ledger says so (slips observed, not inferred).
+    assert backend.tracing.slip_count() >= 1
+    assert sum(len(es) for b in got for es in b) >= 4
+
+
+def test_logger_stackdriver_warn_severity_and_rotation_collision(tmp_path):
+    """Satellites: Cloud Logging severity names (WARN→WARNING) and
+    same-millisecond rotation backups must not overwrite each other."""
+    import json
+
+    from nakama_tpu.logger import Logger, RotatingFile
+
+    class Sink:
+        def __init__(self):
+            self.lines = []
+
+        def write(self, s):
+            self.lines.append(s)
+
+    sink = Sink()
+    log = Logger(level=logging.DEBUG, fmt="stackdriver", streams=[sink])
+    log.warn("w")
+    log.error("e")
+    log.info("i")
+    log.debug("d")
+    sev = [json.loads(line)["severity"] for line in sink.lines]
+    assert sev == ["WARNING", "ERROR", "INFO", "DEBUG"]
+
+    # Rotation: three rotations fast enough to share a millisecond stamp
+    # must yield three distinct backups (no silent os.replace overwrite).
+    path = str(tmp_path / "rot.log")
+    rf = RotatingFile(path, max_size_mb=1)
+    rf.max_bytes = 64  # force a rotation per write
+    payload = "x" * 80 + "\n"
+    for _ in range(4):
+        rf.write(payload)
+    rf.close()
+    backups = rf._backups()
+    assert len(backups) >= 3, backups
+    assert len(set(backups)) == len(backups)

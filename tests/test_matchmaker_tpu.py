@@ -29,6 +29,11 @@ def tpu_config(**kw):
         numeric_fields=8,
         string_fields=8,
         max_constraints=8,
+        # Matching-semantics tests pin the synchronous path (one
+        # process() == one delivered interval); the shipped default is
+        # pipelined and has its own tests (test_matchmaker_cadence.py
+        # and the pipelined cases below, which opt back in).
+        interval_pipelining=False,
     )
     defaults.update(kw)
     return MatchmakerConfig(**defaults)
@@ -373,7 +378,7 @@ def test_device_pool_rebuild_from_host_extract():
     mm2.insert(snapshot)
     assert len(mm2) == 12
     mm2.process()
-    mm2.process()  # pipelined second pass if enabled (it isn't by default)
+    mm2.process()  # pipelined second pass if enabled (not in this helper)
     users = {
         e.presence.user_id for batch in got2 for match in batch for e in match
     }
@@ -527,6 +532,63 @@ def test_device_pairing_parity_with_oracle_validity():
     mm.process()
     tpu_total = sum(len(es) for b in got for es in b)
     assert tpu_total >= cpu_total - 2, (tpu_total, cpu_total)
+
+
+def test_device_pairing_engages_under_pipelining():
+    # The shipped default posture for a pure-1v1 big pool: pipelined
+    # intervals + device pairing. The handshake must run, delivery must
+    # land through the queued dispatch→collect flow (mid-gap collect,
+    # no second process()), and matches must stay exactly valid.
+    mm, got = _pairing_mm(interval_pipelining=True)
+    calls = []
+    import nakama_tpu.matchmaker.device2 as d2
+
+    orig = d2.pair_partners
+    d2.pair_partners = lambda *a, **kw: calls.append(1) or orig(*a, **kw)
+    try:
+        mode_of = _fill_pairs(mm, 128)
+        mm.process()  # dispatch only: pipelined interval
+        assert calls, "pairing handshake did not run under pipelining"
+        assert not got  # delivery is mid-gap, not same-interval
+        mm.backend.wait_idle(30)
+        mm.collect_pipelined()
+    finally:
+        d2.pair_partners = orig
+    matched = 0
+    for batch in got:
+        for entry_set in batch:
+            assert len(entry_set) == 2
+            a, b = entry_set
+            assert mode_of[a.presence.user_id] == mode_of[b.presence.user_id]
+            assert a.presence.session_id != b.presence.session_id
+            matched += 2
+    assert matched >= 120, matched
+
+
+def test_pipelined_deadline_surface_and_guarded_collect():
+    import time
+
+    mm, got = make_tpu_mm(interval_pipelining=True, max_intervals=10)
+    assert mm._next_cohort_deadline() is None
+    add(mm, "properties.mode:a", strs={"mode": "a"})
+    add(mm, "properties.mode:a", strs={"mode": "a"})
+    mm.process()  # dispatch cohort 0
+    deadline = mm._next_cohort_deadline()
+    # Deadline = dispatch + one interval (15s default here), in the
+    # future and bounded by it.
+    now = time.perf_counter()
+    assert deadline is not None and now < deadline <= now + 16
+    assert mm.backend.pipeline_depth() == 1
+    # Guard-style collect: block-joins the head cohort's assembly and
+    # delivers it NOW — no second process(), no explicit wait_idle.
+    batch = mm.collect_pipelined(block_until=time.perf_counter() + 30)
+    assert batch is not None and len(batch) == 1
+    assert len(got) == 1 and len(got[0][0]) == 2
+    assert mm._next_cohort_deadline() is None
+    assert mm.backend.pipeline_depth() == 0
+    # The delivery ledger recorded the cohort, unslipped.
+    deliveries = mm.backend.tracing.recent_deliveries()
+    assert deliveries and deliveries[-1]["slipped"] is False
 
 
 def test_pair_partners_pad_rows_do_not_clobber_slot0():

@@ -157,6 +157,8 @@ def test_embedding_scoring_prefers_similar():
     cfg = MatchmakerConfig(
         pool_capacity=64, candidates_per_ticket=64, numeric_fields=8,
         string_fields=8, max_constraints=8, embedding_dims=4,
+        # Synchronous oracle path: one process() == one delivery.
+        interval_pipelining=False,
     )
     backend = TpuBackend(cfg, quiet_logger(), row_block=8, col_block=8)
     got = []
