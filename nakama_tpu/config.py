@@ -83,6 +83,20 @@ class DatabaseConfig:
     driver: str = "sqlite"  # sqlite today; asyncpg seam for postgres
     conn_max_lifetime_ms: int = 3_600_000
     max_open_conns: int = 100
+    # Reader pool width (file-backed WAL engines only; capped by
+    # max_open_conns at construction, server.py). 8 matches the
+    # pre-knob hardcoded server pool so existing deployments keep
+    # their read parallelism.
+    read_pool_size: int = 8
+    # Group-commit write pipeline (storage/db.py WriteBatcher):
+    # concurrent auto-commit writes coalesce into shared commits.
+    # group_commit=False keeps the legacy one-commit-per-write path.
+    group_commit: bool = True
+    write_batch_max: int = 256  # most units one drain may share a commit
+    write_queue_depth: int = 4096  # queued units before submitters park
+    # Bounded linger (ms) before a non-full drain commits; 0 = drain
+    # immediately (commit latency already batches concurrent writers).
+    write_drain_deadline_ms: int = 0
 
 
 @dataclass

@@ -88,18 +88,29 @@ class Notifications:
             if record["persistent"]:
                 persist_rows.append(record)
         if persist_rows:
-            async with self.db.tx() as tx:
-                for r in persist_rows:
-                    await tx.execute(
-                        "INSERT INTO notification (id, user_id, subject,"
-                        " content, code, sender_id, create_time)"
-                        " VALUES (?, ?, ?, ?, ?, ?, ?)",
-                        (
-                            r["id"], r["user_id"], r["subject"],
-                            json.dumps(r["content"]), r["code"],
-                            r["sender_id"], r["create_time"],
-                        ),
-                    )
+            params = [
+                (
+                    r["id"], r["user_id"], r["subject"],
+                    json.dumps(r["content"]), r["code"],
+                    r["sender_id"], r["create_time"],
+                )
+                for r in persist_rows
+            ]
+            sql = (
+                "INSERT INTO notification (id, user_id, subject,"
+                " content, code, sender_id, create_time)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?)"
+            )
+            if hasattr(self.db, "execute_many"):
+                # One atomic unit inside a shared group commit
+                # (storage/db.py execute_many): same all-rows-or-none
+                # semantics as the transaction, without the exclusive
+                # writer lock.
+                await self.db.execute_many(sql, params)
+            else:
+                async with self.db.tx() as tx:
+                    for p in params:
+                        await tx.execute(sql, p)
         for user_id, records in by_user.items():
             self._route(user_id, records)
         return out

@@ -17,13 +17,19 @@ server/core_storage.go:395-697):
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import hashlib
 import json
 import time
 from dataclasses import dataclass
 
-from ..storage.db import Database
+from ..storage.db import (
+    OCC_RETRIES,
+    Database,
+    UniqueViolationError,
+    WriteConflictError,
+)
 
 
 class StorageError(Exception):
@@ -105,9 +111,156 @@ async def storage_write_objects(
     """Batch transactional write (reference StorageWriteObjects
     core_storage.go:467). `caller_id=None` is the system/runtime caller and
     bypasses ownership + write-permission checks; a client caller may only
-    write its own objects and only where permission_write allows."""
+    write its own objects and only where permission_write allows.
+
+    Hot path: optimistic reads + one guarded write unit through the
+    group-commit pipeline (storage/db.py submit_write), so concurrent
+    storage writes share a WAL commit. Version checks stay exact: each
+    UPDATE is guarded on the version read, an INSERT race surfaces as a
+    unique violation, and either conflict retries the whole batch from
+    fresh reads (all-or-nothing is the unit's savepoint). Falls back to
+    the exclusive transaction after OCC_RETRIES conflicts or when group
+    commit is off."""
+    keys = [(op.collection, op.key, op.user_id) for op in ops]
+    if getattr(db, "group_commit", False) and len(set(keys)) == len(keys):
+        # A duplicate (collection, key, user_id) in ONE call would
+        # deterministically conflict with itself (the first write
+        # invalidates the second's version read) — straight to the tx
+        # path, which re-reads between statements.
+        for _ in range(OCC_RETRIES):
+            try:
+                return await _write_objects_batched(db, caller_id, ops)
+            except (WriteConflictError, UniqueViolationError):
+                continue
     async with db.tx() as tx:
         return await storage_write_objects_in_tx(tx, caller_id, ops)
+
+
+def _validate_write_op(op: StorageOpWrite, caller_id: str | None) -> None:
+    """Row-independent checks (fields, value JSON, permission values,
+    ownership) — the batched path runs them BEFORE paying for any read,
+    so invalid calls fail deterministically and cheaply."""
+    if not op.collection or not op.key:
+        raise StorageError("collection and key are required")
+    _validate_value(op.value)
+    if op.permission_read not in (0, 1, 2) or op.permission_write not in (0, 1):
+        raise StorageError("invalid permission values")
+    if caller_id is not None and op.user_id and op.user_id != caller_id:
+        raise StoragePermissionError(
+            "cannot write objects owned by another user"
+        )
+    if caller_id is not None and not op.user_id:
+        raise StoragePermissionError(
+            "cannot write system-owned objects"
+        )
+
+
+def _plan_write_op(
+    op: StorageOpWrite,
+    caller_id: str | None,
+    row: dict | None,
+    now: float,
+    guard_version: bool,
+) -> tuple[str, tuple, bool, StorageAck]:
+    """Validate one write op against the row read for it and return
+    ``(sql, params, guarded, ack)``. ONE body for both write paths so
+    their permission/version semantics cannot diverge — the batched OCC
+    path plans with ``guard_version=True`` (UPDATE conditioned AND
+    guarded on the version read, so a concurrent writer rolls the unit
+    back for retry; an INSERT race trips the primary key instead), the
+    tx path with ``False`` (the open transaction already serializes)."""
+    _validate_write_op(op, caller_id)
+    new_version = _version_of(op.value)
+    ack = StorageAck(op.collection, op.key, op.user_id, new_version)
+    if row is None:
+        # Insert path: fails OCC if a specific version was expected.
+        if op.version and op.version != "*":
+            raise StorageVersionError("version check failed")
+        return (
+            "INSERT INTO storage (collection, key, user_id, value,"
+            " version, read, write, create_time, update_time)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (
+                op.collection,
+                op.key,
+                op.user_id,
+                op.value,
+                new_version,
+                op.permission_read,
+                op.permission_write,
+                now,
+                now,
+            ),
+            False,
+            ack,
+        )
+    if caller_id is not None and row["write"] != 1:
+        raise StoragePermissionError("write permission denied")
+    if op.version == "*":
+        # If-not-exists write over an existing object.
+        raise StorageVersionError("version check failed")
+    if op.version and op.version != row["version"]:
+        raise StorageVersionError("version check failed")
+    sql = (
+        "UPDATE storage SET value = ?, version = ?, read = ?,"
+        " write = ?, update_time = ?"
+        " WHERE collection = ? AND key = ? AND user_id = ?"
+    )
+    params = (
+        op.value,
+        new_version,
+        op.permission_read,
+        op.permission_write,
+        now,
+        op.collection,
+        op.key,
+        op.user_id,
+    )
+    if guard_version:
+        sql += " AND version = ?"
+        params += (row["version"],)
+        if caller_id is not None:
+            # Re-assert the validated write permission at commit time:
+            # version is md5(value), so a concurrent permission-only
+            # change leaves it unchanged and the version guard alone
+            # cannot see the revocation (the tx path serializes the
+            # check under the writer lock instead).
+            sql += " AND write = 1"
+    return sql, params, guard_version, ack
+
+
+async def _write_objects_batched(
+    db: Database,
+    caller_id: str | None,
+    ops: list[StorageOpWrite],
+) -> list[StorageAck]:
+    acks: list[StorageAck] = []
+    stmts: list[tuple] = []
+    guards: list[bool] = []
+    now = time.time()
+    # Cheap validation first: an invalid op fails before any read.
+    for op in ops:
+        _validate_write_op(op, caller_id)
+    # Concurrent reads: the coalescer collapses them into shared
+    # reader-pool hops instead of one serial round trip per op.
+    rows = await asyncio.gather(*(
+        db.fetch_one(
+            "SELECT version, write FROM storage"
+            " WHERE collection = ? AND key = ? AND user_id = ?",
+            (op.collection, op.key, op.user_id),
+        )
+        for op in ops
+    ))
+    for op, row in zip(ops, rows):
+        sql, params, guarded, ack = _plan_write_op(
+            op, caller_id, row, now, guard_version=True
+        )
+        stmts.append((sql, params))
+        guards.append(guarded)
+        acks.append(ack)
+    if stmts:
+        await db.submit_write(stmts, guards)
+    return acks
 
 
 async def storage_write_objects_in_tx(
@@ -121,71 +274,16 @@ async def storage_write_objects_in_tx(
     acks: list[StorageAck] = []
     now = time.time()
     for op in ops:
-        if not op.collection or not op.key:
-            raise StorageError("collection and key are required")
-        _validate_value(op.value)
-        if op.permission_read not in (0, 1, 2) or op.permission_write not in (0, 1):
-            raise StorageError("invalid permission values")
-        if caller_id is not None and op.user_id and op.user_id != caller_id:
-            raise StoragePermissionError(
-                "cannot write objects owned by another user"
-            )
-        if caller_id is not None and not op.user_id:
-            raise StoragePermissionError(
-                "cannot write system-owned objects"
-            )
         row = await tx.fetch_one(
             "SELECT version, write FROM storage"
             " WHERE collection = ? AND key = ? AND user_id = ?",
             (op.collection, op.key, op.user_id),
         )
-        new_version = _version_of(op.value)
-        if row is None:
-            # Insert path: fails OCC if a specific version was expected.
-            if op.version and op.version != "*":
-                raise StorageVersionError("version check failed")
-            await tx.execute(
-                "INSERT INTO storage (collection, key, user_id, value,"
-                " version, read, write, create_time, update_time)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
-                (
-                    op.collection,
-                    op.key,
-                    op.user_id,
-                    op.value,
-                    new_version,
-                    op.permission_read,
-                    op.permission_write,
-                    now,
-                    now,
-                ),
-            )
-        else:
-            if caller_id is not None and row["write"] != 1:
-                raise StoragePermissionError("write permission denied")
-            if op.version == "*":
-                # If-not-exists write over an existing object.
-                raise StorageVersionError("version check failed")
-            if op.version and op.version != row["version"]:
-                raise StorageVersionError("version check failed")
-            await tx.execute(
-                "UPDATE storage SET value = ?, version = ?, read = ?,"
-                " write = ?, update_time = ?"
-                " WHERE collection = ? AND key = ? AND user_id = ?",
-                (
-                    op.value,
-                    new_version,
-                    op.permission_read,
-                    op.permission_write,
-                    now,
-                    op.collection,
-                    op.key,
-                    op.user_id,
-                ),
-            )
-        acks.append(
-            StorageAck(op.collection, op.key, op.user_id, new_version)
+        sql, params, _, ack = _plan_write_op(
+            op, caller_id, row, now, guard_version=False
         )
+        await tx.execute(sql, params)
+        acks.append(ack)
     return acks
 
 

@@ -12,9 +12,12 @@ transaction.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 import uuid
+
+from ..storage.db import OCC_RETRIES, WriteConflictError
 
 
 class WalletError(Exception):
@@ -67,33 +70,77 @@ class Wallets:
         self, updates: list[dict], update_ledger: bool = True
     ) -> list[dict]:
         """updates: [{user_id, changeset, metadata}]; all-or-nothing
-        (reference UpdateWallets core_wallet.go:52)."""
+        (reference UpdateWallets core_wallet.go:52).
+
+        Hot path: optimistic read + ONE guarded write unit through the
+        group-commit pipeline (storage/db.py submit_write) — concurrent
+        wallet updates share a WAL commit instead of serializing on the
+        exclusive writer lock. Each user's UPDATE is guarded on the
+        exact wallet value read, so a concurrent change rolls the whole
+        unit back (all-or-nothing preserved) and the update retries;
+        after OCC_RETRIES conflicts — or when group commit is off —
+        the legacy exclusive-transaction path takes over."""
+        ids = [u["user_id"] for u in updates]
+        if getattr(self.db, "group_commit", False) and (
+            len(set(ids)) == len(ids)
+        ):
+            # A duplicate user in ONE call would deterministically trip
+            # its own guard (the first UPDATE invalidates the second's
+            # read) — such calls go straight to the tx path, which
+            # re-reads between statements.
+            for _ in range(OCC_RETRIES):
+                try:
+                    return await self._update_batched(
+                        updates, update_ledger
+                    )
+                except WriteConflictError:
+                    continue
         async with self.db.tx() as tx:
             return await self._update_in_tx(tx, updates, update_ledger)
 
-    async def _update_in_tx(
-        self, tx, updates: list[dict], update_ledger: bool
-    ) -> list[dict]:
-        now = time.time()
-        results = []
-        for u in updates:
-            user_id = u["user_id"]
-            changeset = u.get("changeset") or {}
-            row = await tx.fetch_one(
-                "SELECT wallet FROM users WHERE id = ?", (user_id,)
+    def _plan_update(
+        self,
+        u: dict,
+        raw: str,
+        now: float,
+        update_ledger: bool,
+        guard_wallet: bool,
+    ) -> tuple[list[tuple], list[bool], dict]:
+        """Plan one user's update from the wallet text read for it:
+        returns ``(statements, guards, result)``. ONE body for both
+        write paths so their semantics cannot diverge — the batched OCC
+        path plans with ``guard_wallet=True`` (UPDATE conditioned AND
+        guarded on the exact wallet text read, so a concurrent writer
+        rolls the unit back for retry), the tx path with ``False`` (the
+        open transaction already serializes)."""
+        user_id = u["user_id"]
+        changeset = u.get("changeset") or {}
+        previous = json.loads(raw)
+        updated = _apply_changeset(previous, changeset)
+        stmts: list[tuple] = []
+        guards: list[bool] = []
+        if guard_wallet:
+            stmts.append(
+                (
+                    "UPDATE users SET wallet = ?, update_time = ?"
+                    " WHERE id = ? AND wallet = ?",
+                    (json.dumps(updated), now, user_id, raw),
+                )
             )
-            if row is None:
-                raise WalletError("user not found", "not_found")
-            previous = json.loads(row["wallet"] or "{}")
-            updated = _apply_changeset(previous, changeset)
-            await tx.execute(
-                "UPDATE users SET wallet = ?, update_time = ? WHERE id = ?",
-                (json.dumps(updated), now, user_id),
+        else:
+            stmts.append(
+                (
+                    "UPDATE users SET wallet = ?, update_time = ?"
+                    " WHERE id = ?",
+                    (json.dumps(updated), now, user_id),
+                )
             )
-            ledger_id = ""
-            if update_ledger and changeset:
-                ledger_id = str(uuid.uuid4())
-                await tx.execute(
+        guards.append(guard_wallet)
+        ledger_id = ""
+        if update_ledger and changeset:
+            ledger_id = str(uuid.uuid4())
+            stmts.append(
+                (
                     "INSERT INTO wallet_ledger (id, user_id, changeset,"
                     " metadata, create_time, update_time)"
                     " VALUES (?, ?, ?, ?, ?, ?)",
@@ -106,14 +153,63 @@ class Wallets:
                         now,
                     ),
                 )
-            results.append(
-                {
-                    "user_id": user_id,
-                    "previous": previous,
-                    "updated": updated,
-                    "ledger_id": ledger_id,
-                }
             )
+            guards.append(False)
+        result = {
+            "user_id": user_id,
+            "previous": previous,
+            "updated": updated,
+            "ledger_id": ledger_id,
+        }
+        return stmts, guards, result
+
+    async def _update_batched(
+        self, updates: list[dict], update_ledger: bool
+    ) -> list[dict]:
+        now = time.time()
+        stmts: list[tuple] = []
+        guards: list[bool] = []
+        results = []
+        # Concurrent reads: the coalescer collapses them into shared
+        # reader-pool hops instead of one serial round trip per user.
+        rows = await asyncio.gather(*(
+            self.db.fetch_one(
+                "SELECT wallet FROM users WHERE id = ?", (u["user_id"],)
+            )
+            for u in updates
+        ))
+        for u, row in zip(updates, rows):
+            if row is None:
+                raise WalletError("user not found", "not_found")
+            s, g, result = self._plan_update(
+                u, row["wallet"] or "{}", now, update_ledger,
+                guard_wallet=True,
+            )
+            stmts += s
+            guards += g
+            results.append(result)
+        if stmts:
+            await self.db.submit_write(stmts, guards)
+        return results
+
+    async def _update_in_tx(
+        self, tx, updates: list[dict], update_ledger: bool
+    ) -> list[dict]:
+        now = time.time()
+        results = []
+        for u in updates:
+            row = await tx.fetch_one(
+                "SELECT wallet FROM users WHERE id = ?", (u["user_id"],)
+            )
+            if row is None:
+                raise WalletError("user not found", "not_found")
+            s, _, result = self._plan_update(
+                u, row["wallet"] or "{}", now, update_ledger,
+                guard_wallet=False,
+            )
+            for sql, params in s:
+                await tx.execute(sql, params)
+            results.append(result)
         return results
 
     async def ledger_update(self, ledger_id: str, metadata: dict) -> dict:

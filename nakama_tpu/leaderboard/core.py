@@ -16,7 +16,12 @@ import time
 import uuid
 from dataclasses import dataclass, field
 
-from ..storage.db import Database
+from ..storage.db import (
+    OCC_RETRIES,
+    Database,
+    UniqueViolationError,
+    WriteConflictError,
+)
 from ..utils import cronexpr
 from .rank_cache import LeaderboardRankCache
 
@@ -265,14 +270,16 @@ class Leaderboards:
             else lb.expiry_at(now)
         )
 
-        async with self.db.tx() as tx:
-            row = await tx.fetch_one(
-                "SELECT score, subscore, num_score, metadata, create_time,"
-                " max_num_score FROM leaderboard_record"
-                " WHERE leaderboard_id = ? AND expiry_time = ?"
-                " AND owner_id = ?",
-                (id, expiry, owner_id),
-            )
+        _SELECT = (
+            "SELECT score, subscore, num_score, metadata, create_time,"
+            " max_num_score FROM leaderboard_record"
+            " WHERE leaderboard_id = ? AND expiry_time = ?"
+            " AND owner_id = ?"
+        )
+
+        def _plan(row):
+            """Apply the operator against `row`; returns the new record
+            fields (shared by the batched OCC path and the tx path)."""
             if row is None or row["num_score"] == 0:
                 # No previous SCORE: a num_score=0 row is a tournament
                 # join marker (Tournaments.join), not a submission — the
@@ -293,13 +300,9 @@ class Leaderboards:
                     new_score, new_sub = cur[0] - score, cur[1] - subscore
                 else:  # best by sort direction
                     if lb.sort_order == SORT_DESC:
-                        new_score, new_sub = max(
-                            (score, subscore), cur
-                        )
+                        new_score, new_sub = max((score, subscore), cur)
                     else:
-                        new_score, new_sub = min(
-                            (score, subscore), cur
-                        )
+                        new_score, new_sub = min((score, subscore), cur)
                 rank_changed = (new_score, new_sub) != cur
             # Per-record override first (TournamentAddAttempt writes it),
             # then the caller's, then the board default.
@@ -315,20 +318,86 @@ class Leaderboards:
                 if metadata is not None
                 else (row["metadata"] if row else "{}")
             )
-            await tx.execute(
-                "INSERT INTO leaderboard_record (leaderboard_id, owner_id,"
-                " username, score, subscore, num_score, metadata,"
-                " create_time, update_time, expiry_time, max_num_score)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
-                " ON CONFLICT (leaderboard_id, expiry_time, owner_id) DO"
-                " UPDATE SET score = ?, subscore = ?, num_score = ?,"
-                " metadata = ?, username = ?, update_time = ?",
-                (
-                    id, owner_id, username, new_score, new_sub, num_score,
-                    meta_json, create_time, now, expiry, limit,
-                    new_score, new_sub, num_score, meta_json, username, now,
-                ),
-            )
+            return new_score, new_sub, num_score, create_time, (
+                rank_changed
+            ), limit, meta_json
+
+        done = False
+        if getattr(self.db, "group_commit", False):
+            # Hot path (score submits): optimistic read + one guarded
+            # write through the group-commit pipeline, so concurrent
+            # submits share a WAL commit. A fresh record INSERTs (a
+            # first-writer race trips the PK -> retry); an existing one
+            # UPDATEs guarded on the num_score read (a concurrent
+            # submit bumps it -> zero rows -> unit rollback -> retry).
+            for _ in range(OCC_RETRIES):
+                row = await self.db.fetch_one(
+                    _SELECT, (id, expiry, owner_id)
+                )
+                (new_score, new_sub, num_score, create_time,
+                 rank_changed, limit, meta_json) = _plan(row)
+                try:
+                    if row is None:
+                        await self.db.submit_write(
+                            [(
+                                "INSERT INTO leaderboard_record"
+                                " (leaderboard_id, owner_id, username,"
+                                " score, subscore, num_score, metadata,"
+                                " create_time, update_time, expiry_time,"
+                                " max_num_score)"
+                                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                                (
+                                    id, owner_id, username, new_score,
+                                    new_sub, num_score, meta_json,
+                                    create_time, now, expiry, limit,
+                                ),
+                            )]
+                        )
+                    else:
+                        await self.db.submit_write(
+                            [(
+                                "UPDATE leaderboard_record SET score = ?,"
+                                " subscore = ?, num_score = ?,"
+                                " metadata = ?, username = ?,"
+                                " update_time = ?"
+                                " WHERE leaderboard_id = ?"
+                                " AND expiry_time = ? AND owner_id = ?"
+                                " AND num_score = ?",
+                                (
+                                    new_score, new_sub, num_score,
+                                    meta_json, username, now,
+                                    id, expiry, owner_id,
+                                    row["num_score"],
+                                ),
+                            )],
+                            guards=[True],
+                        )
+                    done = True
+                    break
+                except (WriteConflictError, UniqueViolationError):
+                    continue
+        if not done:
+            async with self.db.tx() as tx:
+                row = await tx.fetch_one(_SELECT, (id, expiry, owner_id))
+                (new_score, new_sub, num_score, create_time,
+                 rank_changed, limit, meta_json) = _plan(row)
+                await tx.execute(
+                    "INSERT INTO leaderboard_record (leaderboard_id,"
+                    " owner_id, username, score, subscore, num_score,"
+                    " metadata, create_time, update_time, expiry_time,"
+                    " max_num_score)"
+                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
+                    " ON CONFLICT (leaderboard_id, expiry_time, owner_id) DO"
+                    " UPDATE SET score = ?, subscore = ?, num_score = ?,"
+                    " metadata = ?, username = ?, update_time = ?",
+                    (
+                        id, owner_id, username, new_score, new_sub,
+                        num_score, meta_json, create_time, now, expiry,
+                        limit,
+                        new_score, new_sub, num_score, meta_json,
+                        username, now,
+                    ),
+                )
         if rank_changed:
             rank = self.ranks.insert(
                 id, expiry, lb.sort_order, owner_id, new_score, new_sub

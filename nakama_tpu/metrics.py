@@ -94,6 +94,29 @@ class Metrics:
             "backpressure",
         )
 
+        # Storage engine: group-commit write pipeline (storage/db.py
+        # WriteBatcher) + the reader-pool concurrency high-water mark.
+        # Batch-size buckets are unit counts per shared commit, not
+        # latencies, so they get their own grid.
+        self.db_write_batch_size = Histogram(
+            "db_write_batch_size",
+            "Write units coalesced per group commit",
+            (),
+            namespace=ns,
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+        )
+        self.db_write_queue_depth = gauge(
+            "db_write_queue_depth", "Write units queued for the next drain"
+        )
+        self.db_group_commits = counter(
+            "db_group_commits_total", "Shared commits drained by the batcher"
+        )
+        self.db_peak_concurrent_reads = gauge(
+            "db_peak_concurrent_reads",
+            "High-water mark of concurrent reader-pool fetches",
+        )
+
         # Message routing / presence events.
         self.outgoing_dropped = counter(
             "socket_outgoing_dropped", "Messages dropped on full session queues"

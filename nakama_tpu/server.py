@@ -55,7 +55,16 @@ class NakamaServer:
         if self.db is None:
             self.db = make_database(
                 config.database.address or [":memory:"],
-                read_pool_size=min(8, config.database.max_open_conns),
+                read_pool_size=min(
+                    config.database.read_pool_size,
+                    config.database.max_open_conns,
+                ),
+                group_commit=config.database.group_commit,
+                write_batch_max=config.database.write_batch_max,
+                write_queue_depth=config.database.write_queue_depth,
+                write_drain_deadline_ms=(
+                    config.database.write_drain_deadline_ms
+                ),
             )
         self._db_connected = False
         self._runtime_modules = runtime_modules or []
@@ -95,6 +104,17 @@ class NakamaServer:
             node,
             backend=matchmaker_backend,
         )
+        # Group-commit batch size / queue depth / commit counter + the
+        # reader-pool high-water mark become scrapeable, and drain spans
+        # (record_db_drain) land in the same Tracing ledger operators
+        # already read interval breadcrumbs from — the matchmaker
+        # backend owns that instance, hence binding after it exists.
+        # (An injected engine gets the same binding: per-server.)
+        if hasattr(self.db, "bind_observability"):
+            self.db.bind_observability(
+                metrics=self.metrics,
+                tracing=getattr(self.matchmaker.backend, "tracing", None),
+            )
         self.runtime = None
         self.matchmaker.on_matched = make_matched_handler(
             log,

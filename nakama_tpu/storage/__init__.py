@@ -13,24 +13,47 @@ Two engines live behind one async seam:
 the engine (reference config.go's DSN does the same).
 """
 
-from .db import Database, DatabaseError, UniqueViolationError, migrate_status
+from .db import (
+    Database,
+    DatabaseError,
+    UniqueViolationError,
+    WriteConflictError,
+    migrate_status,
+)
 
 
-def make_database(addresses, read_pool_size: int = 4):
+def make_database(
+    addresses,
+    read_pool_size: int = 4,
+    group_commit: bool = True,
+    write_batch_max: int = 256,
+    write_queue_depth: int = 4096,
+    write_drain_deadline_ms: int = 0,
+):
     """Engine factory: postgres:// DSNs get the wire-protocol engine,
-    everything else the embedded SQLite engine."""
+    everything else the embedded SQLite engine. Both take the same
+    group-commit knobs (config.database.*) so the write-pipeline
+    semantics are engine-independent."""
     addrs = [addresses] if isinstance(addresses, str) else list(addresses)
+    knobs = dict(
+        read_pool_size=read_pool_size,
+        group_commit=group_commit,
+        write_batch_max=write_batch_max,
+        write_queue_depth=write_queue_depth,
+        write_drain_deadline_ms=write_drain_deadline_ms,
+    )
     if addrs and addrs[0].startswith(("postgres://", "postgresql://")):
         from .pg import PostgresDatabase
 
-        return PostgresDatabase(addrs, read_pool_size=read_pool_size)
-    return Database(addrs, read_pool_size=read_pool_size)
+        return PostgresDatabase(addrs, **knobs)
+    return Database(addrs, **knobs)
 
 
 __all__ = [
     "Database",
     "DatabaseError",
     "UniqueViolationError",
+    "WriteConflictError",
     "make_database",
     "migrate_status",
 ]

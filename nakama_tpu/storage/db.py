@@ -16,31 +16,428 @@ last committed snapshot, so a fetch never sees another task's open
 transaction; read-your-committed-writes holds because every write path
 commits before returning. `:memory:` databases (tests) cannot share
 state across connections and quietly keep the single-threaded path.
+Concurrent pool fetches are COALESCED (ReadCoalescer): the dominant
+cost of a sub-ms WAL read is the asyncio→thread round trip, so a chunk
+of queued fetches shares one executor hop per reader thread.
+
+Group-commit write pipeline (the batched write surface the reference
+leans on Postgres' batched WAL flush for, server/db.go:35): concurrent
+auto-commit writes — ``execute``, ``execute_many``, ``submit_write`` —
+are enqueued as atomic UNITS and drained by the writer thread in
+batches, one ``BEGIN IMMEDIATE … COMMIT`` per drain. Each unit runs
+inside its own SAVEPOINT so a failing statement rolls back only its own
+unit (the rest of the batch commits untouched) and its error surfaces
+to exactly the caller that enqueued it. A unit statement may be marked
+as a GUARD: if a guarded statement matches zero rows, the whole unit is
+rolled back to its savepoint and the caller gets `WriteConflictError` —
+the seam optimistic-concurrency callers (wallet, leaderboard) retry on.
+Per-call futures resolve only after the shared COMMIT, so durability
+and read-your-committed-writes semantics are exactly the per-commit
+path's. Explicit ``tx()`` blocks still take the exclusive writer lock;
+the batcher drains and parks while a transaction is open.
+
+Durability semantics: the engine runs WAL mode with synchronous=NORMAL,
+so the atomicity unit a crash preserves is the COMMIT — with group
+commit, one commit covers a whole batch, so after a crash either every
+unit of a group is visible or none of it is (commit-batch atomicity).
+A resolved await is therefore "committed to the WAL" exactly as before;
+group commit changes only how many logical writes share that commit.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
-import itertools
 import sqlite3
 import threading
-from typing import Any, Iterable
+import time
+from typing import Any, Iterable, Sequence
 
 from .migrations import MIGRATIONS
 
 READ_POOL_SIZE = 4
+READ_BATCH_MAX = 64
+WRITE_BATCH_MAX = 256
+WRITE_QUEUE_DEPTH = 4096
+WRITE_DRAIN_DEADLINE_MS = 0
+# Retry budget the optimistic-concurrency callers of the guarded write
+# surface (wallet, storage, leaderboard) share before falling back to
+# their exclusive-transaction paths (guaranteed progress).
+OCC_RETRIES = 8
 
 
 class DatabaseError(Exception):
     pass
 
 
-class Database:
+class WriteConflictError(DatabaseError):
+    """A guarded statement in a batched write unit matched no rows; the
+    unit was rolled back to its savepoint and nothing from it committed.
+    Optimistic-concurrency callers re-read and retry on this."""
+
+
+class _WriteUnit:
+    __slots__ = ("stmts", "guards", "future")
+
+    def __init__(self, stmts, guards, future):
+        self.stmts = stmts
+        self.guards = guards
+        self.future = future
+
+
+class _GroupAborted(Exception):
+    """A failing statement took the WHOLE group transaction down with it
+    (SQLITE_FULL/IOERR/NOMEM auto-rollback), not just its savepoint —
+    nothing committed, so the batch re-runs unit-by-unit."""
+
+
+class WriteBatcher:
+    """Engine-agnostic group-commit queue.
+
+    FIFO pending units, one lazily-spawned drainer task per burst. The
+    drainer takes the owning engine's writer lock once per batch, hands
+    the batch to ``db._run_write_group(units)`` (engine-specific: the
+    SQLite engine executes it on the writer thread, the PG engine
+    pipelines it over the wire), and resolves each unit's future after
+    the shared commit. Backpressure: a bounded semaphore caps queued
+    units; submitters park when the queue is full.
+    """
+
+    def __init__(self, db, batch_max: int, queue_depth: int,
+                 drain_deadline_ms: int):
+        self._db = db
+        self.batch_max = max(1, batch_max)
+        self.queue_depth = max(1, queue_depth)
+        self.drain_deadline_s = max(0, drain_deadline_ms) / 1000.0
+        self._queue: collections.deque[_WriteUnit] = collections.deque()
+        self._sem = asyncio.Semaphore(self.queue_depth)
+        self._drain_task: asyncio.Task | None = None
+        # Observability (read by bench.py and exported via bound Metrics).
+        # units_committed counts only units whose results were OK —
+        # guard-conflicted/failed units rolled back to their savepoints
+        # land in units_conflicted instead, so committed throughput is
+        # not overstated exactly when contention is high.
+        self.group_commits = 0
+        self.units_committed = 0
+        self.units_conflicted = 0
+        self.batch_size_counts: collections.Counter = collections.Counter()
+
+    def stats(self) -> dict:
+        return {
+            "group_commits": self.group_commits,
+            "units_committed": self.units_committed,
+            "units_conflicted": self.units_conflicted,
+            "batch_sizes": dict(self.batch_size_counts),
+        }
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    async def write_unit(self, stmts, guards) -> list[int]:
+        """Engine-facing entry for one atomic write unit: group-commit
+        submit when enabled, else the same unit semantics as a batch of
+        exactly one under the writer lock (the before/after bench seam).
+        ONE body for both engines so the dispatch cannot diverge."""
+        if not self._db._connected():
+            raise DatabaseError("database not connected")
+        if guards is None:
+            guards = (False,) * len(stmts)
+        if self._db.group_commit:
+            return await self.submit(stmts, guards)
+        async with self._db._lock:
+            results = await self._db._run_write_group(
+                [_WriteUnit(stmts, guards, None)]
+            )
+        ok, payload = results[0]
+        if not ok:
+            raise payload
+        return payload
+
+    async def submit(self, stmts, guards) -> list[int]:
+        await self._sem.acquire()
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._queue.append(_WriteUnit(stmts, guards, fut))
+        metrics = self._db.metrics
+        if metrics is not None:
+            metrics.db_write_queue_depth.set(len(self._queue))
+        self._kick(loop)
+        return await fut
+
+    def _kick(self, loop) -> None:
+        if self._drain_task is None or self._drain_task.done():
+            self._drain_task = loop.create_task(self._drain_loop())
+
+    async def _drain_loop(self):
+        try:
+            while self._queue:
+                if (
+                    self.drain_deadline_s > 0
+                    and len(self._queue) < self.batch_max
+                ):
+                    # Bounded linger so a trickle of writers can coalesce
+                    # into one commit (off by default: commit latency
+                    # already provides natural batching under load).
+                    await asyncio.sleep(self.drain_deadline_s)
+                async with self._db._lock:
+                    batch: list[_WriteUnit] = []
+                    while self._queue and len(batch) < self.batch_max:
+                        unit = self._queue.popleft()
+                        self._sem.release()
+                        if not unit.future.done():  # caller gone: skip
+                            batch.append(unit)
+                    if not batch:
+                        continue
+                    if not self._db._connected():
+                        err = DatabaseError("database not connected")
+                        for u in batch:
+                            u.future.set_exception(err)
+                        continue
+                    t0 = time.perf_counter()
+                    try:
+                        results = await self._db._run_write_group(batch)
+                    except Exception as e:
+                        err = (
+                            e if isinstance(e, DatabaseError)
+                            else DatabaseError(str(e))
+                        )
+                        for u in batch:
+                            if not u.future.done():
+                                u.future.set_exception(err)
+                        continue
+                ok_count = sum(1 for ok, _ in results if ok)
+                self._note(len(batch), ok_count, time.perf_counter() - t0)
+                for unit, (ok, payload) in zip(batch, results):
+                    if unit.future.done():
+                        continue
+                    if ok:
+                        unit.future.set_result(payload)
+                    else:
+                        unit.future.set_exception(payload)
+        finally:
+            self._drain_task = None
+            if self._queue:  # a submit raced this task's shutdown
+                self._kick(asyncio.get_running_loop())
+
+    def _note(self, batch_len: int, ok_count: int, dt: float) -> None:
+        self.group_commits += 1
+        self.units_committed += ok_count
+        self.units_conflicted += batch_len - ok_count
+        self.batch_size_counts[batch_len] += 1
+        metrics = self._db.metrics
+        if metrics is not None:
+            metrics.db_write_batch_size.observe(batch_len)
+            metrics.db_group_commits.inc()
+            metrics.db_write_queue_depth.set(len(self._queue))
+        tracing = self._db.tracing
+        if tracing is not None:
+            tracing.record_db_drain(
+                batch=batch_len,
+                drain_s=dt,
+                queue_depth=len(self._queue),
+            )
+
+    async def flush(self):
+        """Wait until every queued unit has been drained."""
+        while self._drain_task is not None:
+            task = self._drain_task
+            try:
+                await task
+            except Exception:
+                pass
+
+    def fail_pending(self, exc: Exception):
+        while self._queue:
+            unit = self._queue.popleft()
+            self._sem.release()
+            if not unit.future.done():
+                unit.future.set_exception(exc)
+
+
+class _ReadOp:
+    __slots__ = ("fn", "future")
+
+    def __init__(self, fn, future):
+        self.fn = fn
+        self.future = future
+
+
+class ReadCoalescer:
+    """Coalesce concurrent reader-pool fetches into shared executor
+    round trips — the read-side twin of the write batcher. The dominant
+    cost of a sub-millisecond WAL read is the asyncio→thread→asyncio
+    hop, not SQLite; under N concurrent readers one chunk of up to
+    ``batch_max`` fetches pays ONE hop per reader thread. One lazily
+    spawned drain task per reader connection keeps the whole pool busy;
+    per-fetch errors resolve per-caller. Sequential awaits from one
+    task still serialize, so read-your-committed-writes is unchanged.
+    """
+
+    def __init__(self, db, batch_max: int = READ_BATCH_MAX):
+        self._db = db
+        self.batch_max = max(1, batch_max)
+        self._queue: collections.deque[_ReadOp] = collections.deque()
+        self._workers: dict[int, asyncio.Task | None] = {}
+
+    async def run(self, fn):
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._queue.append(_ReadOp(fn, fut))
+        self._kick(loop)
+        return await fut
+
+    def _kick(self, loop) -> None:
+        for i in range(len(self._db._readers)):
+            task = self._workers.get(i)
+            if task is None or task.done():
+                self._workers[i] = loop.create_task(self._drain(i))
+                return  # one fresh worker per kick; queue growth re-kicks
+
+    async def _drain(self, idx: int):
+        loop = asyncio.get_running_loop()
+        try:
+            while self._queue:
+                pool = len(self._db._readers)
+                if idx >= pool:
+                    return  # pool shrank (close): failed by fail_pending
+                ex, conn = self._db._readers[idx]
+                # Spread a burst over the WHOLE pool: cap this chunk at
+                # its fair share (ceil(queue/pool)) so 64 queued reads
+                # land ~16-per-connection, not 64 serialized on one.
+                limit = min(
+                    self.batch_max,
+                    max(1, -(-len(self._queue) // pool)),
+                )
+                batch: list[_ReadOp] = []
+                while self._queue and len(batch) < limit:
+                    op = self._queue.popleft()
+                    if not op.future.done():
+                        batch.append(op)
+                if not batch:
+                    return
+
+                def _chunk():
+                    # Gauge per FETCH, not per chunk: the chunk queues
+                    # on one connection, so true concurrency is the
+                    # number of busy reader threads, not burst size.
+                    out = []
+                    gauge = None
+                    for op in batch:
+                        g = self._db._note_reads(1)
+                        try:
+                            try:
+                                out.append((True, op.fn(conn)))
+                            except Exception as e:
+                                out.append((False, e))
+                        finally:
+                            self._db._note_reads(-1)
+                        if g is not None:
+                            gauge = g
+                    return out, gauge
+
+                try:
+                    results, gauge = await loop.run_in_executor(ex, _chunk)
+                except Exception as e:
+                    # Executor shut down mid-drain (close racing reads):
+                    # resolve the popped futures instead of abandoning
+                    # their callers to await forever.
+                    err = (
+                        e if isinstance(e, DatabaseError)
+                        else DatabaseError(str(e))
+                    )
+                    for op in batch:
+                        if not op.future.done():
+                            op.future.set_exception(err)
+                    continue
+                metrics = self._db.metrics
+                if metrics is not None and gauge is not None:
+                    metrics.db_peak_concurrent_reads.set(gauge)
+                for op, (ok, payload) in zip(batch, results):
+                    if op.future.done():
+                        continue
+                    if ok:
+                        op.future.set_result(payload)
+                    elif isinstance(payload, sqlite3.Error):
+                        op.future.set_exception(
+                            self._db._map_sqlite_error(payload)
+                        )
+                    else:
+                        op.future.set_exception(payload)
+        finally:
+            self._workers[idx] = None
+            if self._queue:  # a run() raced this worker's shutdown
+                self._kick(asyncio.get_running_loop())
+
+    def fail_pending(self, exc: Exception):
+        """Resolve every still-queued read with `exc` (close path: the
+        pool is gone, so no worker will ever pick them up)."""
+        while self._queue:
+            op = self._queue.popleft()
+            if not op.future.done():
+                op.future.set_exception(exc)
+
+
+def _apply_unit_stmts(conn: sqlite3.Connection, stmts, guards) -> list[int]:
+    """Run one unit's statements on `conn`, enforcing zero-row guards.
+    THE definition of unit/guard semantics for the SQLite engine — the
+    in-tx, savepoint, and solo-commit paths all share it so they cannot
+    drift (pg.py's async twin is `_apply_unit_stmts`)."""
+    counts = []
+    for (sql, params), guarded in zip(stmts, guards):
+        count = conn.execute(sql, params).rowcount
+        if guarded and count == 0:
+            raise WriteConflictError("guarded statement matched no rows")
+        counts.append(count)
+    return counts
+
+
+def _normalize_unit(
+    stmts: Sequence, guards: Sequence[bool] | None
+) -> tuple[list[tuple[str, tuple]], tuple[bool, ...]]:
+    norm = [(sql, tuple(params)) for sql, params in stmts]
+    if guards is None:
+        g = (False,) * len(norm)
+    else:
+        g = tuple(bool(x) for x in guards)
+        if len(g) != len(norm):
+            raise ValueError("guards must match statements 1:1")
+    return norm, g
+
+
+class GroupCommitObservability:
+    """Shared observability surface of both engines (SQLite here, PG in
+    pg.py): optional Metrics/Tracing sinks plus the group-commit
+    counters the batcher keeps."""
+
+    metrics = None
+    tracing = None
+
+    def bind_observability(self, metrics=None, tracing=None) -> None:
+        """Attach a Metrics and/or Tracing sink: group-commit batch-size
+        histogram, queue-depth gauge, commit counter, peak-reads gauge,
+        and a per-drain tracing breadcrumb."""
+        if metrics is not None:
+            self.metrics = metrics
+        if tracing is not None:
+            self.tracing = tracing
+
+    def write_batch_stats(self) -> dict:
+        """Group-commit counters for benches/tests: commits, units, and
+        the batch-size distribution."""
+        return self._batcher.stats()
+
+
+class Database(GroupCommitObservability):
     def __init__(
         self,
         path: str | list[str] = ":memory:",
         read_pool_size: int = READ_POOL_SIZE,
+        group_commit: bool = True,
+        write_batch_max: int = WRITE_BATCH_MAX,
+        write_queue_depth: int = WRITE_QUEUE_DEPTH,
+        write_drain_deadline_ms: int = WRITE_DRAIN_DEADLINE_MS,
     ):
         # Multi-address failover seam (reference DbConnect db.go:35 tries
         # each DSN in order): the first address that opens wins.
@@ -60,11 +457,20 @@ class Database:
         self._readers: list[
             tuple[concurrent.futures.ThreadPoolExecutor, sqlite3.Connection]
         ] = []
-        self._reader_rr = itertools.count()
         # Observability for tests/metrics: peak concurrent reader calls.
         self._read_gauge_lock = threading.Lock()
         self._reads_in_flight = 0
         self.peak_concurrent_reads = 0
+        # Group-commit write pipeline (module docstring): auto-commit
+        # writes coalesce into shared commits. group_commit=False keeps
+        # the per-commit path (and makes the seam callers take their
+        # legacy transaction paths) — the before/after bench seam.
+        self.group_commit = bool(group_commit)
+        self._write_knobs = (
+            write_batch_max, write_queue_depth, write_drain_deadline_ms,
+        )
+        self._batcher = WriteBatcher(self, *self._write_knobs)
+        self._read_coalescer = ReadCoalescer(self)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -85,6 +491,11 @@ class Database:
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="nakama-db"
             )
+        # Fresh batcher + coalescer per connect (matching pg.py): their
+        # asyncio primitives bind to the loop they first run on, and a
+        # reconnect may be on a new loop.
+        self._batcher = WriteBatcher(self, *self._write_knobs)
+        self._read_coalescer = ReadCoalescer(self)
         last_error: Exception | None = None
         for path in self.addresses:
             try:
@@ -133,12 +544,16 @@ class Database:
             self._readers.append((ex, conn))
 
     async def close(self) -> None:
+        # Let in-flight group commits finish so already-awaited writes
+        # resolve rather than dying with the connection.
+        await self._batcher.flush()
         # Take the lock so we never close under an open transaction.
         async with self._lock:
             if self._conn is not None:
                 conn = self._conn
                 self._conn = None
                 await self._run(conn.close)
+        self._batcher.fail_pending(DatabaseError("database closed"))
         self._executor.shutdown(wait=False)
         readers, self._readers = self._readers, []
         loop = asyncio.get_running_loop()
@@ -148,6 +563,7 @@ class Database:
             except Exception:
                 pass
             ex.shutdown(wait=False)
+        self._read_coalescer.fail_pending(DatabaseError("database closed"))
 
     async def migrate(self) -> list[str]:
         """Apply embedded migrations in order; returns names applied
@@ -215,19 +631,62 @@ class Database:
 
     async def execute(self, sql: str, params: Iterable[Any] = ()) -> int:
         """Run one statement; returns affected row count. Inside this task's
-        open ``tx()`` it joins the transaction; otherwise auto-commits."""
+        open ``tx()`` it joins the transaction; otherwise auto-commits —
+        through the group-commit pipeline when it is enabled, so
+        concurrent callers share one WAL commit."""
         in_tx = asyncio.current_task() is self._tx_owner
 
-        def _exec(conn: sqlite3.Connection) -> int:
-            cur = conn.execute(sql, tuple(params))
-            if not in_tx:
-                conn.commit()
-            return cur.rowcount
-
         if in_tx:
+            def _exec(conn: sqlite3.Connection) -> int:
+                return conn.execute(sql, tuple(params)).rowcount
+
             return await self._with_conn(_exec)
-        async with self._lock:
+        counts = await self._write_unit([(sql, tuple(params))], None)
+        return counts[0]
+
+    async def execute_many(
+        self, sql: str, params_seq: Iterable[Iterable[Any]]
+    ) -> int:
+        """Run one statement for each parameter tuple as ONE atomic unit
+        (all rows commit together or none do); returns total affected
+        rows. Batched with other writers' units into a shared commit."""
+        stmts = [(sql, tuple(p)) for p in params_seq]
+        if not stmts:
+            return 0
+        if asyncio.current_task() is self._tx_owner:
+            def _exec(conn: sqlite3.Connection) -> int:
+                return sum(
+                    conn.execute(s, p).rowcount for s, p in stmts
+                )
+
             return await self._with_conn(_exec)
+        return sum(await self._write_unit(stmts, None))
+
+    async def submit_write(
+        self,
+        stmts: Sequence,
+        guards: Sequence[bool] | None = None,
+    ) -> list[int]:
+        """Enqueue one atomic write unit: a list of ``(sql, params)``
+        statements applied together inside the next group commit.
+        Returns per-statement rowcounts after the shared commit.
+
+        ``guards[i]=True`` marks statement i as a guard: if it matches
+        zero rows the unit rolls back to its savepoint (nothing from the
+        unit commits) and the call raises `WriteConflictError` — the
+        optimistic-concurrency seam wallet/leaderboard retry loops use.
+        Inside this task's open ``tx()`` the statements join the
+        transaction directly (a guard failure raises and the enclosing
+        transaction rolls back as a whole)."""
+        norm, g = _normalize_unit(stmts, guards)
+        if asyncio.current_task() is self._tx_owner:
+            return await self._with_conn(
+                lambda conn: _apply_unit_stmts(conn, norm, g)
+            )
+        return await self._write_unit(norm, g)
+
+    async def _write_unit(self, stmts, guards) -> list[int]:
+        return await self._batcher.write_unit(stmts, guards)
 
     async def fetch_all(
         self, sql: str, params: Iterable[Any] = ()
@@ -268,31 +727,113 @@ class Database:
 
     # ------------------------------------------------------------ internals
 
+    def _connected(self) -> bool:
+        return self._conn is not None
+
+    @staticmethod
+    def _map_sqlite_error(e: sqlite3.Error) -> DatabaseError:
+        if isinstance(e, sqlite3.IntegrityError) and (
+            "UNIQUE constraint failed" in str(e)
+        ):
+            return UniqueViolationError(str(e))
+        return DatabaseError(str(e))
+
+    async def _run_write_group(self, units: list[_WriteUnit]) -> list:
+        """Execute a batch of write units as ONE transaction on the writer
+        thread; returns ``[(ok, rowcounts | exception), ...]`` unit-wise.
+        Caller (the batcher / per-commit fallback) holds the writer lock."""
+        conn = self._conn
+
+        def _unit_in_savepoint(unit: _WriteUnit, i: int):
+            sp = f"nk_gc_{i}"
+            conn.execute(f"SAVEPOINT {sp}")
+            try:
+                counts = _apply_unit_stmts(conn, unit.stmts, unit.guards)
+            except (sqlite3.Error, WriteConflictError) as e:
+                try:
+                    conn.execute(f"ROLLBACK TO {sp}")
+                    conn.execute(f"RELEASE {sp}")
+                except sqlite3.Error:
+                    # SQLITE_FULL/IOERR/NOMEM auto-rolled-back the whole
+                    # transaction and the savepoint with it; every prior
+                    # unit's work is gone too — re-run the batch solo.
+                    raise _GroupAborted(e) from e
+                if isinstance(e, WriteConflictError):
+                    return (False, e)
+                return (False, self._map_sqlite_error(e))
+            conn.execute(f"RELEASE {sp}")
+            return (True, counts)
+
+        def _unit_solo(unit: _WriteUnit):
+            # Fallback when the group's own BEGIN/COMMIT failed: retry
+            # each unit with its own commit so one poisoned unit can't
+            # take the whole batch down with it.
+            try:
+                counts = _apply_unit_stmts(conn, unit.stmts, unit.guards)
+                conn.commit()
+                return (True, counts)
+            except (sqlite3.Error, WriteConflictError) as e:
+                if conn.in_transaction:
+                    conn.rollback()
+                if isinstance(e, WriteConflictError):
+                    return (False, e)
+                return (False, self._map_sqlite_error(e))
+
+        def _group():
+            try:
+                conn.execute("BEGIN IMMEDIATE")
+            except sqlite3.Error:
+                return [_unit_solo(u) for u in units]
+            try:
+                results = []
+                for i, u in enumerate(units):
+                    try:
+                        results.append(_unit_in_savepoint(u, i))
+                    except _GroupAborted:
+                        # Nothing committed (the auto-rollback undid
+                        # prior units too): restart the batch solo.
+                        return [_unit_solo(x) for x in units]
+            except BaseException:
+                # Never leave the connection inside the dead group
+                # transaction: a later solo commit would resurrect its
+                # partial work after callers were told they failed.
+                try:
+                    if conn.in_transaction:
+                        conn.rollback()
+                except sqlite3.Error:
+                    pass
+                raise
+            try:
+                conn.commit()
+            except sqlite3.Error:
+                try:
+                    conn.rollback()
+                except sqlite3.Error:
+                    pass
+                return [_unit_solo(u) for u in units]
+            return results
+
+        return await self._run(_group)
+
     async def _run(self, fn, *args):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._executor, fn, *args)
 
+    def _note_reads(self, delta: int):
+        """Adjust the in-flight reader-fetch count; returns the new peak
+        when it advanced (the caller exports it to metrics), else None."""
+        with self._read_gauge_lock:
+            self._reads_in_flight += delta
+            if self._reads_in_flight > self.peak_concurrent_reads:
+                self.peak_concurrent_reads = self._reads_in_flight
+                return self.peak_concurrent_reads
+        return None
+
     async def _run_reader(self, fn):
-        """Run a read on the next pool connection — no writer lock; WAL
-        isolation guarantees a committed snapshot."""
-        ex, conn = self._readers[
-            next(self._reader_rr) % len(self._readers)
-        ]
-
-        def _call():
-            with self._read_gauge_lock:
-                self._reads_in_flight += 1
-                if self._reads_in_flight > self.peak_concurrent_reads:
-                    self.peak_concurrent_reads = self._reads_in_flight
-            try:
-                return fn(conn)
-            finally:
-                with self._read_gauge_lock:
-                    self._reads_in_flight -= 1
-
-        loop = asyncio.get_running_loop()
+        """Run a read on the pool via the coalescer — no writer lock;
+        WAL isolation guarantees a committed snapshot."""
         try:
-            return await loop.run_in_executor(ex, _call)
+            return await self._read_coalescer.run(fn)
         except sqlite3.Error as e:
             raise DatabaseError(str(e)) from e
 

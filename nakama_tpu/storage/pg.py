@@ -40,7 +40,14 @@ from base64 import b64decode, b64encode
 from typing import Any, Iterable
 from urllib.parse import unquote, urlparse
 
-from .db import DatabaseError, UniqueViolationError
+from .db import (
+    DatabaseError,
+    GroupCommitObservability,
+    UniqueViolationError,
+    WriteBatcher,
+    WriteConflictError,
+    _normalize_unit,
+)
 from .migrations import MIGRATIONS
 
 
@@ -76,6 +83,12 @@ def scram_client_final(
         hmac.new(server_key, auth_msg.encode(), hashlib.sha256).digest()
     ).decode()
     return f"{final_nosig},p={proof}", server_sig
+
+
+class _CommitAckLost(Exception):
+    """The writer socket died while the group COMMIT was in flight: the
+    server may or may not have committed, so the batch must fail to its
+    callers rather than retry (double-apply risk)."""
 
 
 class PgProtocolError(DatabaseError):
@@ -441,7 +454,7 @@ def to_pg_ddl(sql: str) -> str:
 # --------------------------------------------------------------- engine
 
 
-class PostgresDatabase:
+class PostgresDatabase(GroupCommitObservability):
     """`Database`-interface engine over the stdlib wire client.
 
     Concurrency model mirrors the SQLite engine: ONE writer connection
@@ -450,7 +463,15 @@ class PostgresDatabase:
     Postgres gives readers full MVCC isolation, so the pool needs no
     WAL tricks."""
 
-    def __init__(self, dsn: str | list[str], read_pool_size: int = 2):
+    def __init__(
+        self,
+        dsn: str | list[str],
+        read_pool_size: int = 2,
+        group_commit: bool = True,
+        write_batch_max: int = 256,
+        write_queue_depth: int = 4096,
+        write_drain_deadline_ms: int = 0,
+    ):
         self.addresses = [dsn] if isinstance(dsn, str) else list(dsn)
         self.path = self.addresses[0]
         self._conn: PgWireConnection | None = None
@@ -462,6 +483,19 @@ class PostgresDatabase:
         self._tx_owner: asyncio.Task | None = None
         self.peak_concurrent_reads = 0
         self._reads_in_flight = 0
+        # Group-commit write pipeline: the same engine-agnostic batcher
+        # as the SQLite engine (db.py WriteBatcher); this engine's
+        # _run_write_group maps a batch onto one BEGIN..SAVEPOINT-per-
+        # unit..COMMIT round over the writer connection — the pipelined
+        # equivalent of pgx's batched WAL flush (reference db.go:35).
+        self.group_commit = bool(group_commit)
+        self._write_knobs = (
+            write_batch_max, write_queue_depth, write_drain_deadline_ms,
+        )
+        self._batcher = WriteBatcher(self, *self._write_knobs)
+
+    def _connected(self) -> bool:
+        return self._conn is not None
 
     @staticmethod
     def _parse(dsn: str):
@@ -480,6 +514,9 @@ class PostgresDatabase:
         return conn
 
     async def connect(self, migrate: bool = True) -> None:
+        # Fresh batcher per connect: its asyncio primitives bind to the
+        # loop they first run on, and a reconnect may be on a new loop.
+        self._batcher = WriteBatcher(self, *self._write_knobs)
         last: Exception | None = None
         for dsn in self.addresses:
             try:
@@ -500,10 +537,13 @@ class PostgresDatabase:
                 break  # degraded: reads fall back to the writer
 
     async def close(self) -> None:
+        # Drain in-flight group commits so awaited writes resolve.
+        await self._batcher.flush()
         for c in [self._conn, *self._readers]:
             if c is not None:
                 await c.close()
         self._conn = None
+        self._batcher.fail_pending(DatabaseError("database closed"))
         self._readers = []
         self._reader_locks = []
 
@@ -608,9 +648,183 @@ class PostgresDatabase:
         if asyncio.current_task() is self._tx_owner:
             _, count = await self._writer_query(sql, params)
             return count
-        async with self._lock:
-            _, count = await self._writer_query(sql, params)
-            return count
+        counts = await self._write_unit([(sql, params)], None)
+        return counts[0]
+
+    async def execute_many(
+        self, sql: str, params_seq: Iterable[Iterable[Any]]
+    ) -> int:
+        """Same contract as the SQLite engine: the rows are ONE atomic
+        unit inside the next group commit."""
+        stmts = [(sql, tuple(p)) for p in params_seq]
+        if not stmts:
+            return 0
+        if asyncio.current_task() is self._tx_owner:
+            total = 0
+            for s, p in stmts:
+                _, count = await self._writer_query(s, p)
+                total += count
+            return total
+        return sum(await self._write_unit(stmts, None))
+
+    async def submit_write(
+        self,
+        stmts,
+        guards=None,
+    ) -> list[int]:
+        """Atomic multi-statement unit with optional zero-row guards —
+        identical semantics to the SQLite engine (db.py submit_write)."""
+        norm, g = _normalize_unit(stmts, guards)
+        if asyncio.current_task() is self._tx_owner:
+            counts = []
+            for (s, p), guarded in zip(norm, g):
+                _, count = await self._writer_query(s, p)
+                if guarded and count == 0:
+                    raise WriteConflictError(
+                        "guarded statement matched no rows"
+                    )
+                counts.append(count)
+            return counts
+        return await self._write_unit(norm, g)
+
+    async def _write_unit(self, stmts, guards) -> list[int]:
+        return await self._batcher.write_unit(stmts, guards)
+
+    async def _run_write_group(self, units: list) -> list:
+        """One BEGIN .. SAVEPOINT-per-unit .. COMMIT round over the
+        writer connection (caller holds the writer lock); returns
+        ``[(ok, rowcounts | exception), ...]`` unit-wise. A savepoint
+        confines a failed unit's aborted-transaction state so the rest
+        of the batch commits (Postgres aborts the whole transaction on
+        error otherwise).
+
+        Connection loss (server restart, LB idle kill) reconnects
+        across the configured addresses and retries the whole group
+        ONCE — the same seam `_writer_query` gives the legacy path —
+        but ONLY when the loss happened before COMMIT was sent, which
+        is the only point retry is provably safe. A socket death during
+        the COMMIT query itself leaves the outcome unknown on the
+        server, and retrying a whole batch would multiply the
+        double-apply exposure across every caller sharing the commit —
+        those units fail to their callers with an explicit
+        commit-state-unknown error instead. Likewise once the per-unit
+        SOLO fallback starts committing, a loss fails the remaining
+        units rather than re-running units already made durable."""
+        try:
+            return await self._run_group_once(units)
+        except _CommitAckLost as e:
+            try:
+                await self._reconnect_writer()
+            except Exception:
+                pass  # next write retries via this method
+            err = DatabaseError(
+                f"connection lost during commit (outcome unknown): {e}"
+            )
+            return [(False, err) for _ in units]
+        except (OSError, asyncio.IncompleteReadError):
+            await self._reconnect_writer()
+            return await self._run_group_once(units)
+
+    @staticmethod
+    async def _apply_unit_stmts(conn, stmts, guards) -> list[int]:
+        """Run one unit's statements over the wire, enforcing zero-row
+        guards — THE definition of unit/guard semantics for this engine
+        (db.py's sync `_apply_unit_stmts` is the SQLite twin)."""
+        counts = []
+        for (sql, params), guarded in zip(stmts, guards):
+            _, count = await conn.query(to_pg_sql(sql), params)
+            if guarded and count == 0:
+                raise WriteConflictError(
+                    "guarded statement matched no rows"
+                )
+            counts.append(count)
+        return counts
+
+    async def _run_group_once(self, units: list) -> list:
+        conn = self._conn
+
+        async def _unit_solo(unit) -> tuple:
+            # Per-unit commit fallback when the group envelope failed.
+            try:
+                await conn.query("BEGIN")
+                counts = await self._apply_unit_stmts(
+                    conn, unit.stmts, unit.guards
+                )
+                await conn.query("COMMIT")
+                return (True, counts)
+            except (PgServerError, WriteConflictError) as e:
+                try:
+                    await conn.query("ROLLBACK")
+                except Exception:
+                    pass
+                if isinstance(e, WriteConflictError):
+                    return (False, e)
+                return (False, self._map_error(e))
+
+        async def _solo_all() -> list:
+            # Units commit one-by-one from here on, so a connection
+            # loss must NOT escape to the group-level retry: committed
+            # units keep their results, the rest fail to their callers.
+            results: list = []
+            for u in units:
+                try:
+                    results.append(await _unit_solo(u))
+                except (OSError, asyncio.IncompleteReadError) as e:
+                    err = DatabaseError(f"connection lost: {e}")
+                    results.extend(
+                        [(False, err)] * (len(units) - len(results))
+                    )
+                    try:
+                        await self._reconnect_writer()
+                    except Exception:
+                        pass  # next write retries via _run_write_group
+                    break
+            return results
+
+        try:
+            await conn.query("BEGIN")
+        except PgServerError:
+            return await _solo_all()
+        results = []
+        try:
+            for i, unit in enumerate(units):
+                sp = f"nk_gc_{i}"
+                try:
+                    await conn.query(f"SAVEPOINT {sp}")
+                    counts = await self._apply_unit_stmts(
+                        conn, unit.stmts, unit.guards
+                    )
+                    await conn.query(f"RELEASE {sp}")
+                    results.append((True, counts))
+                except (PgServerError, WriteConflictError) as e:
+                    await conn.query(f"ROLLBACK TO {sp}")
+                    await conn.query(f"RELEASE {sp}")
+                    if isinstance(e, WriteConflictError):
+                        results.append((False, e))
+                    else:
+                        results.append((False, self._map_error(e)))
+        except BaseException:
+            # Unexpected failure (e.g. the savepoint recovery itself):
+            # never leave the connection inside the dead group
+            # transaction — roll back before surfacing.
+            try:
+                await conn.query("ROLLBACK")
+            except Exception:
+                pass
+            raise
+        try:
+            await conn.query("COMMIT")
+        except (OSError, asyncio.IncompleteReadError) as e:
+            # The server may or may not have committed: retrying the
+            # group risks double-apply, so surface the ambiguity.
+            raise _CommitAckLost(str(e)) from e
+        except PgServerError:
+            try:
+                await conn.query("ROLLBACK")
+            except Exception:
+                pass
+            return await _solo_all()
+        return results
 
     async def _read(self, sql: str, params: tuple) -> list[dict]:
         if asyncio.current_task() is self._tx_owner:

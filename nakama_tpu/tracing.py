@@ -29,6 +29,9 @@ class Tracing:
         # deadline slips): slips are observable here and via metrics,
         # not inferred from bench WARN lines.
         self.deliveries: deque[dict] = deque(maxlen=capacity)
+        # Group-commit drain spans from the storage write batcher
+        # (record_db_drain): batch size / drain time / queue depth.
+        self.db_drains: deque[dict] = deque(maxlen=capacity)
         if port:
             self.start_profiler_server(port)
 
@@ -91,3 +94,16 @@ class Tracing:
         """Deliveries in the retained window that missed their cohort's
         interval deadline."""
         return sum(1 for d in self.deliveries if d.get("slipped"))
+
+    # ---------------------------------------------------- db drain spans
+
+    def record_db_drain(self, **fields):
+        """One group-commit drain by the storage write batcher: batch
+        size, drain duration, and post-drain queue depth (storage/db.py
+        WriteBatcher). A separate ledger so high-rate write drains don't
+        evict the interval breadcrumbs."""
+        fields.setdefault("ts", time.time())
+        self.db_drains.append(fields)
+
+    def recent_db_drains(self, n: int = 32) -> list[dict]:
+        return list(self.db_drains)[-n:]

@@ -1,0 +1,463 @@
+"""Group-commit write pipeline semantics (storage/db.py WriteBatcher).
+
+The batched surface must be observationally identical to the
+one-commit-per-write path: read-your-committed-writes per caller, one
+caller's failure invisible to batch-mates, exclusive tx() still
+exclusive, and crash atomicity at group-commit granularity (WAL +
+synchronous=NORMAL: a crash keeps whole commits, so whole groups).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from nakama_tpu.storage.db import (
+    Database,
+    DatabaseError,
+    UniqueViolationError,
+    WriteConflictError,
+)
+
+
+async def _open(tmp, **kw) -> Database:
+    db = Database(f"{tmp}/gc.db", read_pool_size=2, **kw)
+    await db.connect()
+    await db.execute(
+        "CREATE TABLE IF NOT EXISTS kv"
+        " (k TEXT PRIMARY KEY, v INTEGER NOT NULL)"
+    )
+    return db
+
+
+async def test_concurrent_writers_monotonic_read_your_writes():
+    """N concurrent writers each bump their own row; after every awaited
+    write the writer's own read must see a value that never regresses —
+    a resolved await means the shared commit covered the write."""
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        errors: list[str] = []
+
+        async def writer(w: int, rounds: int):
+            key = f"w{w}"
+            await db.execute(
+                "INSERT INTO kv (k, v) VALUES (?, 0)", (key,)
+            )
+            last = 0
+            for i in range(1, rounds + 1):
+                await db.execute(
+                    "UPDATE kv SET v = ? WHERE k = ?", (i, key)
+                )
+                row = await db.fetch_one(
+                    "SELECT v FROM kv WHERE k = ?", (key,)
+                )
+                if row is None or row["v"] < i or row["v"] < last:
+                    errors.append(f"w{w}@{i}: read {row}")
+                last = row["v"]
+
+        await asyncio.gather(*(writer(w, 20) for w in range(12)))
+        assert not errors
+        stats = db.write_batch_stats()
+        # The writers genuinely coalesced: fewer commits than units.
+        assert stats["units_committed"] >= 12 * 21
+        assert stats["group_commits"] < stats["units_committed"]
+        await db.close()
+
+
+async def test_failing_statement_surfaces_to_its_caller_only():
+    """One poisoned unit inside a batch fails exactly its own caller;
+    batch-mates commit untouched (per-unit savepoints)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        await db.execute("INSERT INTO kv (k, v) VALUES ('dup', 1)")
+
+        async def good(i: int):
+            return await db.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?)", (f"g{i}", i)
+            )
+
+        async def bad_unique():
+            await db.execute("INSERT INTO kv (k, v) VALUES ('dup', 2)")
+
+        async def bad_sql():
+            await db.execute("INSERT INTO no_such_table VALUES (1)")
+
+        results = await asyncio.gather(
+            *(good(i) for i in range(8)),
+            bad_unique(),
+            bad_sql(),
+            return_exceptions=True,
+        )
+        assert results[:8] == [1] * 8
+        assert isinstance(results[8], UniqueViolationError)
+        assert isinstance(results[9], DatabaseError)
+        rows = await db.fetch_all("SELECT k FROM kv WHERE k LIKE 'g%'")
+        assert len(rows) == 8
+        row = await db.fetch_one("SELECT v FROM kv WHERE k = 'dup'")
+        assert row["v"] == 1
+        await db.close()
+
+
+async def test_guarded_unit_rolls_back_whole_unit():
+    """A guard matching zero rows must undo every statement of ITS unit
+    (savepoint rollback) and raise WriteConflictError to its caller."""
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        await db.execute("INSERT INTO kv (k, v) VALUES ('occ', 5)")
+        with pytest.raises(WriteConflictError):
+            await db.submit_write(
+                [
+                    ("INSERT INTO kv (k, v) VALUES ('side', 1)", ()),
+                    (
+                        "UPDATE kv SET v = 6 WHERE k = 'occ' AND v = ?",
+                        (999,),  # stale expectation -> zero rows
+                    ),
+                ],
+                guards=[False, True],
+            )
+        # Nothing from the unit committed — not even the first insert.
+        assert await db.fetch_one("SELECT * FROM kv WHERE k='side'") is None
+        row = await db.fetch_one("SELECT v FROM kv WHERE k = 'occ'")
+        assert row["v"] == 5
+        # A matching guard commits the whole unit.
+        counts = await db.submit_write(
+            [
+                ("INSERT INTO kv (k, v) VALUES ('side', 1)", ()),
+                ("UPDATE kv SET v = 6 WHERE k = 'occ' AND v = ?", (5,)),
+            ],
+            guards=[False, True],
+        )
+        assert counts == [1, 1]
+        row = await db.fetch_one("SELECT v FROM kv WHERE k = 'occ'")
+        assert row["v"] == 6
+        await db.close()
+
+
+async def test_execute_many_is_one_atomic_unit():
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        n = await db.execute_many(
+            "INSERT INTO kv (k, v) VALUES (?, ?)",
+            [(f"m{i}", i) for i in range(5)],
+        )
+        assert n == 5
+        # One duplicate poisons the whole unit: none of its rows land.
+        with pytest.raises(UniqueViolationError):
+            await db.execute_many(
+                "INSERT INTO kv (k, v) VALUES (?, ?)",
+                [("fresh1", 1), ("m0", 9), ("fresh2", 2)],
+            )
+        rows = await db.fetch_all(
+            "SELECT k FROM kv WHERE k IN ('fresh1', 'fresh2')"
+        )
+        assert rows == []
+        await db.close()
+
+
+async def test_open_tx_parks_then_releases_the_batcher():
+    """Auto-commit writes queued while an explicit tx() is open must not
+    land inside (or interleave with) the transaction; they drain after
+    it releases the writer lock."""
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        entered = asyncio.Event()
+        release = asyncio.Event()
+
+        async def tx_holder():
+            async with db.tx() as tx:
+                await tx.execute(
+                    "INSERT INTO kv (k, v) VALUES ('tx', 1)"
+                )
+                entered.set()
+                await release.wait()
+
+        holder = asyncio.create_task(tx_holder())
+        await entered.wait()
+        queued = asyncio.create_task(
+            db.execute("INSERT INTO kv (k, v) VALUES ('queued', 1)")
+        )
+        await asyncio.sleep(0.1)
+        assert not queued.done()  # parked behind the open transaction
+        release.set()
+        await holder
+        assert await queued == 1
+        row = await db.fetch_one("SELECT v FROM kv WHERE k = 'queued'")
+        assert row["v"] == 1
+        await db.close()
+
+
+async def test_tx_writes_by_owner_task_bypass_the_queue():
+    """The tx owner's own execute/execute_many/submit_write join the
+    open transaction instead of deadlocking behind the parked batcher."""
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        with pytest.raises(WriteConflictError):
+            async with db.tx():
+                assert await db.execute(
+                    "INSERT INTO kv (k, v) VALUES ('own', 1)"
+                ) == 1
+                assert await db.execute_many(
+                    "INSERT INTO kv (k, v) VALUES (?, ?)",
+                    [("own2", 2), ("own3", 3)],
+                ) == 2
+                assert await db.submit_write(
+                    [("UPDATE kv SET v = 9 WHERE k = ?", ("own",))],
+                    guards=[True],
+                ) == [1]
+                await db.submit_write(
+                    [("UPDATE kv SET v = 1 WHERE k = ?", ("nope",))],
+                    guards=[True],
+                )
+        rows = await db.fetch_all("SELECT k FROM kv ORDER BY k")
+        # The propagated guard failure rolled back the WHOLE transaction
+        # (documented submit_write-inside-tx semantics: the error joins
+        # the open transaction, so letting it escape the `async with`
+        # undoes every statement in it).
+        assert rows == []
+        await db.close()
+
+
+async def test_per_commit_fallback_same_semantics():
+    """group_commit=False keeps the whole surface working through the
+    one-unit-per-commit path."""
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp, group_commit=False)
+        assert await db.execute(
+            "INSERT INTO kv (k, v) VALUES ('a', 1)"
+        ) == 1
+        with pytest.raises(WriteConflictError):
+            await db.submit_write(
+                [("UPDATE kv SET v = 2 WHERE k = 'zzz'", ())],
+                guards=[True],
+            )
+        assert await db.execute_many(
+            "INSERT INTO kv (k, v) VALUES (?, ?)", [("b", 2), ("c", 3)]
+        ) == 2
+        assert db.write_batch_stats()["group_commits"] == 0
+        await db.close()
+
+
+_CRASH_CHILD = r"""
+import asyncio, os, sqlite3, sys
+
+path = sys.argv[1]
+
+async def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(path)))
+    from nakama_tpu.storage.db import Database
+
+    db = Database(path, read_pool_size=0)
+    await db.connect()
+    await db.execute(
+        "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v INTEGER)"
+    )
+    # Group A: a real group commit through the batcher — must survive.
+    await asyncio.gather(*(
+        db.execute("INSERT INTO kv (k, v) VALUES (?, ?)", (f"ok{i}", i))
+        for i in range(8)
+    ))
+
+asyncio.run(main())
+
+# Group B: a writer dying MID-BATCH — statements executed, commit never
+# reached. Same connection settings as the engine (WAL + NORMAL).
+conn = sqlite3.connect(path)
+conn.execute("PRAGMA journal_mode=WAL")
+conn.execute("PRAGMA synchronous=NORMAL")
+conn.execute("BEGIN IMMEDIATE")
+for i in range(8):
+    conn.execute("INSERT INTO kv (k, v) VALUES (?, ?)", (f"dead{i}", i))
+os._exit(1)  # crash before COMMIT: no atexit, no rollback, no close
+"""
+
+
+def test_wal_crash_recovery_keeps_whole_groups_only():
+    """Kill the writer mid-batch; reopening must show every unit of the
+    committed group and NOTHING of the uncommitted one (commit-batch
+    atomicity under WAL + synchronous=NORMAL)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        path = f"{tmp}/crash.db"
+        proc = subprocess.run(
+            [sys.executable, "-c", _CRASH_CHILD, path],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 1, proc.stderr
+
+        async def verify():
+            db = Database(path, read_pool_size=0)
+            await db.connect()
+            rows = await db.fetch_all("SELECT k FROM kv ORDER BY k")
+            keys = {r["k"] for r in rows}
+            assert keys == {f"ok{i}" for i in range(8)}
+            await db.close()
+
+        asyncio.run(verify())
+
+
+async def test_close_fails_pending_and_reconnect_works():
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        await asyncio.gather(*(
+            db.execute("INSERT INTO kv (k, v) VALUES (?, ?)", (f"r{i}", i))
+            for i in range(4)
+        ))
+        await db.close()
+        with pytest.raises(DatabaseError):
+            await db.execute("INSERT INTO kv (k, v) VALUES ('x', 1)")
+        await db.connect()
+        assert await db.execute(
+            "INSERT INTO kv (k, v) VALUES ('after', 1)"
+        ) == 1
+        rows = await db.fetch_all("SELECT k FROM kv")
+        # The 4 pre-close writes + 'after'; the rejected post-close
+        # write never landed.
+        assert {r["k"] for r in rows} == {"r0", "r1", "r2", "r3", "after"}
+        await db.close()
+
+
+async def test_close_during_concurrent_reads_resolves_not_hangs():
+    """Readers caught by close() must resolve (row or DatabaseError) —
+    never await forever on an abandoned coalescer future."""
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        await db.execute_many(
+            "INSERT INTO kv (k, v) VALUES (?, ?)",
+            [(f"c{i}", i) for i in range(8)],
+        )
+
+        async def reader(i: int):
+            try:
+                return await db.fetch_one(
+                    "SELECT v FROM kv WHERE k = ?", (f"c{i % 8}",)
+                )
+            except DatabaseError:
+                return "err"
+
+        tasks = [asyncio.create_task(reader(i)) for i in range(64)]
+        await asyncio.sleep(0)  # let readers enqueue before the close
+        await db.close()
+        results = await asyncio.wait_for(
+            asyncio.gather(*tasks), timeout=10
+        )
+        assert all(
+            r == "err" or (r is not None and r["v"] is not None)
+            for r in results
+        )
+
+
+async def test_duplicate_keys_in_one_call_apply_sequentially():
+    """Intra-call duplicate keys would deterministically self-conflict
+    on the guarded batched path (the first write invalidates the
+    second's read); wallet and storage route such calls to the tx path
+    and both writes still apply in order."""
+    from tests.fixtures import quiet_logger
+
+    from nakama_tpu.core.storage import (
+        StorageOpWrite,
+        storage_write_objects,
+    )
+    from nakama_tpu.core.wallet import Wallets
+
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        uid = "00000000-0000-4000-8000-000000000001"
+        await db.execute(
+            "INSERT INTO users (id, username, create_time, update_time)"
+            " VALUES (?, 'dup', 0, 0)",
+            (uid,),
+        )
+        wallets = Wallets(quiet_logger(), db)
+        res = await wallets.update_wallets(
+            [
+                {"user_id": uid, "changeset": {"gold": 1}, "metadata": {}},
+                {"user_id": uid, "changeset": {"gold": 2, "gem": 5},
+                 "metadata": {}},
+            ],
+            True,
+        )
+        assert res[1]["updated"] == {"gold": 3, "gem": 5}
+        acks = await storage_write_objects(
+            db,
+            None,
+            [
+                StorageOpWrite(
+                    collection="c", key="k", user_id=uid, value='{"v": 1}'
+                ),
+                StorageOpWrite(
+                    collection="c", key="k", user_id=uid, value='{"v": 2}'
+                ),
+            ],
+        )
+        row = await db.fetch_one(
+            "SELECT value, version FROM storage"
+            " WHERE collection = 'c' AND key = 'k' AND user_id = ?",
+            (uid,),
+        )
+        assert row["value"] == '{"v": 2}'
+        assert row["version"] == acks[1].version
+        await db.close()
+
+
+def test_batched_plan_reasserts_write_permission_at_commit():
+    """The batched UPDATE must re-check write permission IN the guard:
+    version is md5(value), so a concurrent permission-only revocation
+    leaves it unchanged and only a `write = 1` predicate can see it.
+    System callers (caller_id=None) skip permission checks entirely."""
+    from nakama_tpu.core.storage import StorageOpWrite, _plan_write_op
+
+    op = StorageOpWrite(
+        collection="c", key="k", user_id="u1", value='{"a": 1}'
+    )
+    row = {"version": "deadbeef", "write": 1}
+    sql, params, guarded, _ = _plan_write_op(
+        op, "u1", row, 0.0, guard_version=True
+    )
+    assert guarded and "AND write = 1" in sql
+    assert params[-1] == "deadbeef"
+    sql_sys, _, guarded_sys, _ = _plan_write_op(
+        op, None, row, 0.0, guard_version=True
+    )
+    assert guarded_sys and "AND write = 1" not in sql_sys
+    sql_tx, _, guarded_tx, _ = _plan_write_op(
+        op, "u1", row, 0.0, guard_version=False
+    )
+    assert not guarded_tx and "AND version" not in sql_tx
+
+
+async def test_observability_bindings_export_db_metrics():
+    """bind_observability wires the batch-size histogram, commit counter,
+    queue gauge, peak-reads gauge (the previously test-only attribute),
+    and the tracing drain ledger."""
+    from nakama_tpu.metrics import Metrics
+    from nakama_tpu.tracing import Tracing
+
+    with tempfile.TemporaryDirectory() as tmp:
+        db = await _open(tmp)
+        metrics = Metrics("t")
+        tracing = Tracing()
+        db.bind_observability(metrics=metrics, tracing=tracing)
+        await asyncio.gather(*(
+            db.execute("INSERT INTO kv (k, v) VALUES (?, ?)", (f"m{i}", i))
+            for i in range(16)
+        ))
+        await asyncio.gather(*(
+            db.fetch_one("SELECT v FROM kv WHERE k = ?", (f"m{i}",))
+            for i in range(16)
+        ))
+        snap = metrics.snapshot()
+        assert snap.get("t_db_group_commits_total", 0) >= 1
+        assert snap.get("t_db_write_batch_size_count", 0) >= 1
+        assert snap.get("t_db_peak_concurrent_reads", 0) >= 1
+        assert "t_db_write_queue_depth" in snap
+        drains = tracing.recent_db_drains()
+        assert drains and drains[-1]["batch"] >= 1
+        assert db.peak_concurrent_reads >= 1
+        await db.close()
