@@ -97,6 +97,12 @@ class DatabaseConfig:
     # Bounded linger (ms) before a non-full drain commits; 0 = drain
     # immediately (commit latency already batches concurrent writers).
     write_drain_deadline_ms: int = 0
+    # Storage self-healing (faults.py degradation ladder): a crashed
+    # write-drain / read-coalescer loop fails its pending futures with
+    # DatabaseError and restarts with backoff; after this many
+    # consecutive crash-restarts the batcher fails fast (new submits
+    # rejected) until a drain succeeds or the engine reconnects.
+    db_drain_restart_max: int = 8
 
 
 @dataclass
@@ -165,6 +171,21 @@ class MatchmakerConfig:
     # Overflow defers to the next interval, oldest-first (the reference's
     # own time-budget pattern: server/matchmaker_process.go:33-46).
     host_budget_per_interval: int = 512
+    # Degradation ladder (faults.py CircuitBreaker in the device
+    # backend): after `breaker_threshold` consecutive transient device
+    # failures (dispatch or collect; a fatal error trips immediately)
+    # the breaker OPENS and intervals run the bounded host-oracle
+    # fallback (host_budget_per_interval still caps it). After
+    # `breaker_cooldown_ms` a half-open probe re-tries the device path;
+    # success closes the breaker, failure re-opens it with the cooldown
+    # doubled (capped at 16x).
+    breaker_threshold: int = 3
+    breaker_cooldown_ms: int = 30_000
+    # Backstop reclamation sweep: a pipelined cohort still unfinished
+    # this long PAST its delivery deadline is abandoned — its slots'
+    # in-flight claims are released and the tickets re-activated so a
+    # wedged fetch/assembly thread can never strand them un-matchable.
+    inflight_reclaim_deadline_ms: int = 60_000
 
 
 @dataclass

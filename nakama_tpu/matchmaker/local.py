@@ -30,6 +30,7 @@ from typing import Callable, Protocol
 
 import numpy as np
 
+from .. import faults
 from ..config import MatchmakerConfig
 from ..logger import Logger
 from ..metrics import Metrics
@@ -566,10 +567,19 @@ class LocalMatchmaker:
         collect = getattr(self.backend, "collect_ready", None)
         if collect is None:
             return None
-        out = collect(
-            rev_precision=self.config.rev_precision,
-            block_until=block_until,
-        )
+        try:
+            out = collect(
+                rev_precision=self.config.rev_precision,
+                block_until=block_until,
+            )
+        except Exception as e:
+            # Defense in depth: the backend reclaims + degrades its own
+            # failures (tpu.py breaker path); anything that still leaks
+            # here must cost ONE collection poll, never the interval
+            # loop. Tickets stay pooled; the backstop reclamation sweep
+            # frees any claim the failure left behind.
+            self.logger.error("pipelined collect failed", error=str(e))
+            return None
         if out is None:
             return None
         batch, matched_slots, reactivate = out
@@ -583,8 +593,35 @@ class LocalMatchmaker:
             self.metrics.mm_matched.inc(batch.entry_count if batch else 0)
             self._update_gauges()
         if len(batch) and self.on_matched is not None:
-            self.on_matched(batch)
+            self._publish(batch)
         return batch
+
+    def _publish(self, batch: MatchBatch):
+        """Deliver a matched batch to `on_matched`, bounded by the fault
+        plane's `delivery.publish` point. The tickets are already
+        removed from the pool by the time delivery runs (reference
+        single-shot semantics), so a failed or dropped publish is
+        counted and logged loudly — the session-facing retry belongs to
+        the consumer — but it must never poison interval bookkeeping."""
+        try:
+            if faults.fire("delivery.publish"):
+                # drop-mode chaos: delivery intentionally discarded.
+                self.logger.warn(
+                    "match delivery dropped (fault armed)",
+                    matches=len(batch),
+                )
+                if self.metrics is not None:
+                    self.metrics.mm_delivery_failed.inc()
+                return
+            self.on_matched(batch)
+        except Exception as e:
+            self.logger.error(
+                "match delivery failed",
+                error=str(e),
+                matches=len(batch),
+            )
+            if self.metrics is not None:
+                self.metrics.mm_delivery_failed.inc()
 
     def process(self) -> MatchBatch:
         """One matching interval (reference Process, matchmaker.go:282-441).
@@ -617,12 +654,32 @@ class LocalMatchmaker:
             )
             expired_slots = active_slots[last]
             t_backend = time.perf_counter()
-            batch, matched_slots, reactivate = self.backend.process_slots(
-                active_slots,
-                last,
-                max_intervals=max_intervals,
-                rev_precision=self.config.rev_precision,
-            )
+            backend_failed = False
+            try:
+                batch, matched_slots, reactivate = (
+                    self.backend.process_slots(
+                        active_slots,
+                        last,
+                        max_intervals=max_intervals,
+                        rev_precision=self.config.rev_precision,
+                    )
+                )
+            except Exception as e:
+                # Defense in depth: the device backend classifies and
+                # absorbs its own failures (tpu.py breaker/reclaim
+                # paths); a backend that still leaks an exception must
+                # cost one interval's matching, never the bookkeeping
+                # around it. Tickets stay pooled; expired min==max
+                # actives get their attempt back next interval.
+                self.logger.error(
+                    "backend process failed; interval degraded",
+                    error=str(e),
+                    backend=type(self.backend).__name__,
+                )
+                backend_failed = True
+                batch = MatchBatch.from_lists([])
+                matched_slots = np.zeros(0, dtype=np.int32)
+                reactivate = expired_slots.astype(np.int32)
 
         t_rm = time.perf_counter()
         store.deactivate(expired_slots)
@@ -645,17 +702,18 @@ class LocalMatchmaker:
             self._update_gauges()
 
         if len(batch) and self.on_matched is not None:
-            self.on_matched(batch)
+            self._publish(batch)
         # Attribute the post-backend tail (slot removal, delivery
         # callback) on the interval's breadcrumb: the p99 work that
         # isn't inside process_slots must still be visible to the bench
         # (VERDICT r4 #2: per-pool breadcrumbs to attribute spikes).
         # Override intervals never called process_slots, so the last
         # crumb is some earlier interval's — updating it would corrupt
-        # that interval's attribution.
+        # that interval's attribution. Likewise a backend that RAISED
+        # out of process_slots recorded no crumb for this interval.
         tracing = (
             getattr(self.backend, "tracing", None)
-            if self.override_fn is None
+            if self.override_fn is None and not backend_failed
             else None
         )
         if tracing is not None and tracing.breadcrumbs:

@@ -35,7 +35,8 @@ import numpy as np
 from ..config import MatchmakerConfig
 from ..logger import Logger
 from ..metrics import Metrics
-from .. import native
+from .. import faults, native
+from ..faults import CLOSED, HALF_OPEN, STATE_CODE, CircuitBreaker, classify_exception
 from .compile import (
     FULL_HI,
     FULL_LO,
@@ -267,6 +268,19 @@ class TpuBackend:
         # kernel); stale-wide ranges only cost precision, never correctness.
         self._grid_lo = np.full(self.fn, np.inf)
         self._grid_hi = np.full(self.fn, -np.inf)
+        # Degradation ladder (faults.py): consecutive transient device
+        # failures (dispatch or collect; fatal errors immediately) open
+        # this breaker and intervals route every active through the
+        # bounded host-oracle fallback until a half-open probe closes it.
+        self.breaker = CircuitBreaker(
+            threshold=getattr(config, "breaker_threshold", 3),
+            cooldown_s=(
+                getattr(config, "breaker_cooldown_ms", 30_000) / 1000.0
+            ),
+            on_transition=self._on_breaker_transition,
+        )
+        self.inflight_reclaimed = 0  # ledger total (tests/console)
+        self._sweep_tick = 0  # gates the O(capacity) orphan scan
 
     def attach(self, store):
         """Bind the LocalMatchmaker's SlotStore: one slot space shared by
@@ -453,6 +467,131 @@ class TpuBackend:
         self._nonpair_mask[slots] = False
         self._in_flight_mask[slots] = False
 
+    # ------------------------------------------------- degradation ladder
+
+    def _on_breaker_transition(self, old: str, new: str, reason: str):
+        if self.metrics is not None:
+            self.metrics.mm_backend_state.set(STATE_CODE[new])
+        self.tracing.record_breaker(
+            kind="matchmaker_backend", old=old, new=new, reason=reason
+        )
+        log = self.logger.warn if new == "open" else self.logger.info
+        log(
+            "matchmaker backend breaker transition",
+            old=old,
+            new=new,
+            reason=reason,
+            cooldown_s=round(self.breaker.cooldown_s, 3),
+        )
+
+    def _note_backend_failure(
+        self, stage: str, exc: Exception, crumb: dict, probe: bool = True
+    ):
+        """Classify + record one device-path failure (dispatch or
+        collect). Transient failures count toward the breaker threshold;
+        a fatal one (programming error) opens it immediately — retrying
+        a deterministic bug N more intervals can't succeed.
+
+        `probe=False` marks a failure that is NOT the half-open probe's
+        answer (a stale pre-outage cohort draining late): while a probe
+        is being judged, such a failure is logged and counted but must
+        not be booked as the probe failing — the probe's own outcome
+        decides the breaker."""
+        kind = classify_exception(exc)
+        if probe or self.breaker.state != HALF_OPEN:
+            self.breaker.record_failure(fatal=(kind == "fatal"))
+        key = f"{stage}_failed"
+        crumb[key] = crumb.get(key, 0) + 1
+        if self.metrics is not None:
+            self.metrics.mm_backend_failures.labels(
+                stage=stage, kind=kind
+            ).inc()
+        log = self.logger.error if kind == "fatal" else self.logger.warn
+        log(
+            "device backend failure",
+            stage=stage,
+            kind=kind,
+            error=str(exc),
+            breaker=self.breaker.state,
+        )
+
+    def _reclaim_inflight(self, slots: np.ndarray, why: str) -> int:
+        """Release in-flight claims for `slots` (still-current gen only
+        is the caller's concern) and re-activate the live ones so they
+        are matchable next interval. Returns the number reclaimed."""
+        if not len(slots):
+            return 0
+        live = slots[self.store.alive[slots]].astype(np.int32)
+        self.store.reactivate(live)
+        n = len(live)
+        if n:
+            self.inflight_reclaimed += n
+            if self.metrics is not None:
+                self.metrics.mm_inflight_reclaimed.inc(n)
+            self.tracing.record_breaker(
+                kind="inflight_reclaim", slots=n, why=why
+            )
+        return n
+
+    def _reclaim_stale(self):
+        """Backstop sweep, run once per process_slots call: (1) abandon
+        queued cohorts still unfinished `inflight_reclaim_deadline_ms`
+        PAST their delivery deadline (a wedged fetch/assembly thread —
+        its eventual results are dropped with the queue entry) and free
+        their slots; (2) clear in-flight bits not covered by ANY queued
+        cohort (the belt-and-braces orphan case no known code path
+        produces). Either way no ticket is ever stranded un-matchable
+        behind a claim nobody will release."""
+        grace = (
+            getattr(self.config, "inflight_reclaim_deadline_ms", 60_000)
+            / 1000.0
+        )
+        import time as _time
+
+        now = _time.perf_counter()
+        abandoned = False
+        while self._pipeline_queue:
+            head = self._pipeline_queue[0]
+            dl = _work_deadline(head)
+            if dl is None or _work_ready(head) or now <= dl + grace:
+                break
+            self._pipeline_queue.popleft()
+            abandoned = True
+            _, w_slots, _, _, w_gen = head
+            mine = w_slots[w_gen[w_slots] == self.store.gen[w_slots]]
+            self._in_flight_mask[mine] = False
+            n = self._reclaim_inflight(mine, "wedged cohort abandoned")
+            if head[0][1].get("probe"):
+                # The abandoned cohort WAS the half-open probe: book its
+                # wedge as the probe's failure, or the breaker waits
+                # half-open forever for an answer that can never come.
+                self.breaker.record_failure()
+            self.logger.warn(
+                "abandoned wedged pipelined cohort",
+                overdue_s=round(now - dl, 1),
+                slots_reclaimed=n,
+            )
+        # The orphan scan costs O(capacity); in steady pipelined state
+        # in-flight bits are always set, so gate it to the one event
+        # that can orphan bits (a cohort abandoned above) plus a sparse
+        # belt-and-braces cadence for the unknown-path case.
+        self._sweep_tick += 1
+        if not (abandoned or self._sweep_tick % 64 == 0):
+            return
+        if not self._in_flight_mask.any():
+            return
+        if self._pipeline_queue:
+            covered = np.zeros(self.pool.capacity, dtype=bool)
+            for w in self._pipeline_queue:
+                covered[w[1]] = True
+            orphan = self._in_flight_mask & ~covered
+        else:
+            orphan = self._in_flight_mask.copy()
+        if orphan.any():
+            slots = np.nonzero(orphan)[0].astype(np.int32)
+            self._in_flight_mask[orphan] = False
+            self._reclaim_inflight(slots, "orphaned in-flight claim")
+
     # -------------------------------------------------------------- process
 
     def process_slots(
@@ -472,15 +611,31 @@ class TpuBackend:
         round 2 and was the north-star latency floor."""
         meta = self.meta
         pipelined = self.config.interval_pipelining
+        # Backstop reclamation first: wedged/orphaned in-flight claims
+        # must release BEFORE this interval filters its dispatch by the
+        # in-flight mask, or a stranded slot stays invisible forever.
+        self._reclaim_stale()
+        # Degradation ladder: an OPEN breaker routes EVERY active
+        # through the bounded host-oracle fallback (the same path
+        # host-only queries already take; host_budget_per_interval still
+        # caps it, overflow defers oldest-first). A half-open probe lets
+        # one dispatch through to test the device path.
+        device_allowed = self.breaker.allow()
+        probe_pending = device_allowed and self.breaker.state == HALF_OPEN
         # Per-interval observability breadcrumb (SURVEY §5: device timing
         # breadcrumbs; the round-1 perf hole was diagnosed blind without
         # these).
-        host_sel = self.host_only_mask[active_slots]
+        if device_allowed:
+            host_sel = self.host_only_mask[active_slots]
+        else:
+            host_sel = np.ones(len(active_slots), dtype=bool)
         n_host = int(host_sel.sum())
         crumb: dict = {
             "actives": len(active_slots),
             "host_actives": n_host,
         }
+        if self.breaker.state != CLOSED:
+            crumb["backend_state"] = self.breaker.state
         span = self.tracing.span
         deferred_slots = None
         if n_host:
@@ -527,7 +682,23 @@ class TpuBackend:
             device_slots = device_slots[ff]
             device_last = device_last[ff]
 
+        sel = self._sel_mask
+        sel[:] = False
+        flat_parts: list[np.ndarray] = []
+        size_parts: list[np.ndarray] = []
+        # Slots whose assembled match was dropped after they may already
+        # have gone inactive (pipelined collection lags dispatch by one
+        # interval): give them another active interval. Budget-deferred
+        # host-only slots likewise — the caller's expiry pass deactivates
+        # min==max actives after ONE processing attempt, and a deferred
+        # slot hasn't had its attempt yet. Failed dispatch/collect slots
+        # ride the same channel (degradation ladder: no ticket strands).
+        react_parts: list[np.ndarray] = []
+        if deferred_slots is not None and len(deferred_slots):
+            react_parts.append(deferred_slots.astype(np.int32))
+
         work = None
+        probe_used = False
         if len(device_slots):
             # Oldest-first fairness for the greedy assembler: primary
             # created_at ns, tie created_seq — normally free via the
@@ -535,29 +706,56 @@ class TpuBackend:
             device_slots, device_last = self._order_dispatch(
                 device_slots, device_last
             )
-            with span(crumb, "flush_s"):
-                self.pool.flush()
-            with span(crumb, "dispatch_s"):
-                pending = self._dispatch(
-                    device_slots, device_last, rev_precision
+            pending = None
+            try:
+                with span(crumb, "flush_s"):
+                    self.pool.flush()
+                with span(crumb, "dispatch_s"):
+                    pending = self._dispatch(
+                        device_slots, device_last, rev_precision
+                    )
+            except Exception as e:
+                # A dispatch that dies — whether before or after any
+                # partial bookkeeping — must strand nothing: no in-flight
+                # claim survives (none was taken yet: claims are only
+                # written below, after _dispatch returned), no cohort is
+                # queued, and the slots stay matchable next interval (the
+                # caller's expiry pass already deactivated min==max
+                # actives, so they re-activate via react_parts).
+                self._note_backend_failure("dispatch", e, crumb)
+                react_parts.append(device_slots.astype(np.int32))
+            else:
+                if probe_pending:
+                    # Tag the half-open probe cohort: only ITS successful
+                    # collection may close the breaker (_accept_work) — a
+                    # pre-outage cohort draining late must not.
+                    pending[1]["probe"] = True
+                    probe_used = True
+                gen_snap = (
+                    self.store.gen.copy() if pipelined else self.store.gen
                 )
-            gen_snap = self.store.gen.copy() if pipelined else self.store.gen
-            work = (
-                pending,
-                device_slots,
-                device_last,
-                len(device_slots),
-                gen_snap,
-            )
-            if pipelined:
-                # Queue it; collection below drains only completed results,
-                # so the dispatch computes + transfers while the server
-                # does everything else (ticket properties are immutable, so
-                # its candidates cannot go stale — only dead slots, masked
-                # at collection).
-                self._in_flight_mask[device_slots] = True
-                self._pipeline_queue.append(work)
-                work = None
+                work = (
+                    pending,
+                    device_slots,
+                    device_last,
+                    len(device_slots),
+                    gen_snap,
+                )
+                if pipelined:
+                    # Queue it; collection below drains only completed
+                    # results, so the dispatch computes + transfers while
+                    # the server does everything else (ticket properties
+                    # are immutable, so its candidates cannot go stale —
+                    # only dead slots, masked at collection).
+                    self._in_flight_mask[device_slots] = True
+                    self._pipeline_queue.append(work)
+                    work = None
+        if probe_pending and not probe_used:
+            # The probe was granted but no dispatch launched (no device
+            # slots, or the dispatch itself failed — the failure already
+            # re-opened the breaker): hand the slot back so the next
+            # interval can probe.
+            self.breaker.release_probe()
 
         ready_works: list[tuple] = []
         if work is not None:
@@ -582,28 +780,21 @@ class TpuBackend:
                 collectable > 0
                 and not ready_works
                 and not len(device_slots)
+                and host_slots is None
             ):
                 # Every remaining active is in-flight and nothing came
                 # back yet: this interval has NOTHING else to do, so
                 # block-drain the head (collection joins its fetch).
                 # Without this, back-to-back process() calls (tests, a
                 # zero-gap cadence) can starve the fetch thread forever
-                # while its slots stay in-flight — livelock.
+                # while its slots stay in-flight — livelock. (With host
+                # work this interval — including breaker-open degraded
+                # intervals, where every active routes host-side — the
+                # interval is NOT empty-handed, and a blocking join on a
+                # possibly-wedged cohort thread would stall delivery;
+                # mid-gap collection and the reclamation sweep own those
+                # cohorts instead.)
                 ready_works.append(self._pipeline_queue.popleft())
-
-        sel = self._sel_mask
-        sel[:] = False
-        flat_parts: list[np.ndarray] = []
-        size_parts: list[np.ndarray] = []
-        # Slots whose assembled match was dropped after they may already
-        # have gone inactive (pipelined collection lags dispatch by one
-        # interval): give them another active interval. Budget-deferred
-        # host-only slots likewise — the caller's expiry pass deactivates
-        # min==max actives after ONE processing attempt, and a deferred
-        # slot hasn't had its attempt yet.
-        react_parts: list[np.ndarray] = []
-        if deferred_slots is not None and len(deferred_slots):
-            react_parts.append(deferred_slots.astype(np.int32))
 
         if host_slots is not None:
             # Runs while the device computes and the candidate lists
@@ -765,7 +956,29 @@ class TpuBackend:
             # gap-time assembly (a slot reused or removed while the
             # thread ran) is exactly the staleness the accept step
             # below already drops via gen/alive masks.
-            n_matches, offsets, flat, ok = self._collect(w_pending)
+            try:
+                n_matches, offsets, flat, ok = self._collect(w_pending)
+            except Exception as e:
+                # Cohort lost (worker crash, device fetch error,
+                # injected fault): its in-flight claims were released
+                # above, so reclamation is just giving the surviving
+                # tickets another active interval — they retry next
+                # dispatch instead of stranding, and the breaker hears
+                # about it.
+                self._note_backend_failure(
+                    "collect", e, crumb,
+                    probe=bool(w_pending[1].get("probe")),
+                )
+                mine = w_slots[w_gen[w_slots] == self.store.gen[w_slots]]
+                n = self._reclaim_inflight(mine, "cohort collect failed")
+                crumb["collect_reclaimed"] = (
+                    crumb.get("collect_reclaimed", 0) + n
+                )
+                return
+        # The cohort's full device→host round trip succeeded: reset the
+        # breaker's failure streak; a half-open PROBE cohort closes it.
+        if self.breaker.state == CLOSED or w_pending[1].get("probe"):
+            self.breaker.record_success()
         holder = w_pending[1]
         t_disp = holder.get("t_dispatch")
         if t_disp is not None:
@@ -1025,6 +1238,7 @@ class TpuBackend:
         """Launch the device top-K for the given active slots; returns an
         opaque pending handle whose transfer AND downstream host assembly
         are already in flight on a worker thread."""
+        faults.fire("device.dispatch")  # chaos: raise/stall the dispatch
         hw = self.pool.high_water
         with_should = self._should_count > 0
         with_embedding = self._emb_count > 0
@@ -1174,6 +1388,10 @@ class TpuBackend:
 
         def _run(out=holder):
             try:
+                # Chaos: stall delays this cohort's readiness (a slow
+                # D2H/assembly); raise surfaces at collect and walks the
+                # reclamation + breaker path.
+                faults.fire("device.collect")
                 if kind == "pairs":
                     partner = np.ascontiguousarray(
                         np.asarray(dev_arrays[0])

@@ -94,6 +94,29 @@ class Metrics:
             "backpressure",
         )
 
+        # Degradation ladder (faults.py): device-backend breaker state,
+        # classified backend failures, in-flight slots reclaimed after a
+        # failure or by the backstop sweep, and delivery-publish faults.
+        self.mm_backend_state = gauge(
+            "matchmaker_backend_state",
+            "Device-backend circuit state (0 closed, 1 open, 2 half-open)",
+        )
+        self.mm_backend_failures = counter(
+            "matchmaker_backend_failures",
+            "Device dispatch/collect failures by stage and classification",
+            ("stage", "kind"),
+        )
+        self.mm_inflight_reclaimed = counter(
+            "matchmaker_inflight_reclaimed",
+            "In-flight ticket slots reclaimed after backend failure or by "
+            "the stale-cohort backstop sweep",
+        )
+        self.mm_delivery_failed = counter(
+            "matchmaker_delivery_failed",
+            "Matched-cohort deliveries dropped or failed in the publish "
+            "callback",
+        )
+
         # Storage engine: group-commit write pipeline (storage/db.py
         # WriteBatcher) + the reader-pool concurrency high-water mark.
         # Batch-size buckets are unit counts per shared commit, not
@@ -115,6 +138,22 @@ class Metrics:
         self.db_peak_concurrent_reads = gauge(
             "db_peak_concurrent_reads",
             "High-water mark of concurrent reader-pool fetches",
+        )
+        self.db_drain_restarts = counter(
+            "db_drain_restarts",
+            "Storage drain-loop crash-restarts (supervised write batcher "
+            "and read coalescer)",
+            ("loop",),
+        )
+
+        # Fault-injection plane (faults.py): armed-point injections
+        # actually delivered. Zero in production (points are armed only
+        # by tests/bench/chaos) — a nonzero value in a live scrape means
+        # someone left a fault armed.
+        self.faults_injected = counter(
+            "faults_injected",
+            "Fault-plane injections delivered, by point and mode",
+            ("point", "mode"),
         )
 
         # Message routing / presence events.

@@ -65,11 +65,19 @@ class NakamaServer:
                 write_drain_deadline_ms=(
                     config.database.write_drain_deadline_ms
                 ),
+                db_drain_restart_max=config.database.db_drain_restart_max,
             )
         self._db_connected = False
         self._runtime_modules = runtime_modules or []
 
         self.metrics = Metrics(config.metrics.namespace)
+        # Fault plane observability: injections delivered by armed
+        # points surface as `faults_injected` on this server's registry
+        # (the plane is process-wide; points are armed only by
+        # tests/bench/chaos, so production scrapes read zero).
+        from . import faults
+
+        faults.PLANE.bind_metrics(self.metrics)
         self.session_registry = LocalSessionRegistry(log, self.metrics)
         self.session_cache = LocalSessionCache(
             config.session.token_expiry_sec,

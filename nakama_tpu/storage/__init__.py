@@ -29,6 +29,7 @@ def make_database(
     write_batch_max: int = 256,
     write_queue_depth: int = 4096,
     write_drain_deadline_ms: int = 0,
+    db_drain_restart_max: int = 8,
 ):
     """Engine factory: postgres:// DSNs get the wire-protocol engine,
     everything else the embedded SQLite engine. Both take the same
@@ -41,6 +42,7 @@ def make_database(
         write_batch_max=write_batch_max,
         write_queue_depth=write_queue_depth,
         write_drain_deadline_ms=write_drain_deadline_ms,
+        db_drain_restart_max=db_drain_restart_max,
     )
     if addrs and addrs[0].startswith(("postgres://", "postgresql://")):
         from .pg import PostgresDatabase

@@ -54,6 +54,8 @@ import threading
 import time
 from typing import Any, Iterable, Sequence
 
+from .. import faults
+from ..faults import jittered_backoff
 from .migrations import MIGRATIONS
 
 READ_POOL_SIZE = 4
@@ -61,6 +63,14 @@ READ_BATCH_MAX = 64
 WRITE_BATCH_MAX = 256
 WRITE_QUEUE_DEPTH = 4096
 WRITE_DRAIN_DEADLINE_MS = 0
+# Self-healing drain supervision (module docstring + faults.py): a
+# crashed drain loop fails its pending futures and restarts with
+# full-jitter backoff in [0, base*2^n] capped at DRAIN_BACKOFF_MAX_S;
+# after DB_DRAIN_RESTART_MAX consecutive crashes the batcher fails
+# fast (submits rejected) until a reconnect builds a fresh one.
+DB_DRAIN_RESTART_MAX = 8
+DRAIN_BACKOFF_BASE_S = 0.02
+DRAIN_BACKOFF_MAX_S = 1.0
 # Retry budget the optimistic-concurrency callers of the guarded write
 # surface (wallet, storage, leaderboard) share before falling back to
 # their exclusive-transaction paths (guaranteed progress).
@@ -105,14 +115,26 @@ class WriteBatcher:
     """
 
     def __init__(self, db, batch_max: int, queue_depth: int,
-                 drain_deadline_ms: int):
+                 drain_deadline_ms: int,
+                 drain_restart_max: int = DB_DRAIN_RESTART_MAX):
         self._db = db
         self.batch_max = max(1, batch_max)
         self.queue_depth = max(1, queue_depth)
         self.drain_deadline_s = max(0, drain_deadline_ms) / 1000.0
+        self.drain_restart_max = max(0, drain_restart_max)
         self._queue: collections.deque[_WriteUnit] = collections.deque()
         self._sem = asyncio.Semaphore(self.queue_depth)
         self._drain_task: asyncio.Task | None = None
+        # Self-healing supervision state: the batch the drainer popped
+        # but has not yet resolved (a crash must fail these futures, not
+        # abandon them), the consecutive-crash streak, the earliest
+        # moment a restarted drainer may run (jittered backoff), and the
+        # fail-fast latch once the restart budget is exhausted.
+        self._inflight: list[_WriteUnit] | None = None
+        self._crash_streak = 0
+        self._resume_at = 0.0
+        self._broken = False
+        self.drain_restarts = 0  # ledger total (tests/bench)
         # Observability (read by bench.py and exported via bound Metrics).
         # units_committed counts only units whose results were OK —
         # guard-conflicted/failed units rolled back to their savepoints
@@ -129,6 +151,7 @@ class WriteBatcher:
             "units_committed": self.units_committed,
             "units_conflicted": self.units_conflicted,
             "batch_sizes": dict(self.batch_size_counts),
+            "drain_restarts": self.drain_restarts,
         }
 
     @property
@@ -156,6 +179,13 @@ class WriteBatcher:
         return payload
 
     async def submit(self, stmts, guards) -> list[int]:
+        if self._broken:
+            raise DatabaseError(
+                "write pipeline disabled after repeated drain crashes;"
+                " reconnect to recover"
+            )
+        if getattr(self._db, "_closing", False):
+            raise DatabaseError("database closing")
         await self._sem.acquire()
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
@@ -171,55 +201,116 @@ class WriteBatcher:
             self._drain_task = loop.create_task(self._drain_loop())
 
     async def _drain_loop(self):
+        """Supervision shell: the drain body's per-batch error handling
+        already maps engine errors onto the affected futures; anything
+        that still escapes (a drainer bug, an injected `db.drain`
+        fault) must NEVER leave a caller awaiting forever — the crash
+        handler fails the popped batch and every queued unit with
+        DatabaseError and schedules a backoff'd restart."""
         try:
-            while self._queue:
-                if (
-                    self.drain_deadline_s > 0
-                    and len(self._queue) < self.batch_max
-                ):
-                    # Bounded linger so a trickle of writers can coalesce
-                    # into one commit (off by default: commit latency
-                    # already provides natural batching under load).
-                    await asyncio.sleep(self.drain_deadline_s)
-                async with self._db._lock:
-                    batch: list[_WriteUnit] = []
-                    while self._queue and len(batch) < self.batch_max:
-                        unit = self._queue.popleft()
-                        self._sem.release()
-                        if not unit.future.done():  # caller gone: skip
-                            batch.append(unit)
-                    if not batch:
-                        continue
-                    if not self._db._connected():
-                        err = DatabaseError("database not connected")
-                        for u in batch:
-                            u.future.set_exception(err)
-                        continue
-                    t0 = time.perf_counter()
-                    try:
-                        results = await self._db._run_write_group(batch)
-                    except Exception as e:
-                        err = (
-                            e if isinstance(e, DatabaseError)
-                            else DatabaseError(str(e))
-                        )
-                        for u in batch:
-                            if not u.future.done():
-                                u.future.set_exception(err)
-                        continue
-                ok_count = sum(1 for ok, _ in results if ok)
-                self._note(len(batch), ok_count, time.perf_counter() - t0)
-                for unit, (ok, payload) in zip(batch, results):
-                    if unit.future.done():
-                        continue
-                    if ok:
-                        unit.future.set_result(payload)
-                    else:
-                        unit.future.set_exception(payload)
+            await self._drain_batches()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._note_crash(e)
         finally:
+            self._inflight = None
             self._drain_task = None
-            if self._queue:  # a submit raced this task's shutdown
+            if self._queue and not self._broken:
                 self._kick(asyncio.get_running_loop())
+
+    async def _drain_batches(self):
+        if self._resume_at:
+            # Crash-restart backoff: the replacement drainer waits out
+            # the jittered delay before touching the engine again.
+            delay = self._resume_at - time.monotonic()
+            self._resume_at = 0.0
+            if delay > 0:
+                await asyncio.sleep(delay)
+        while self._queue:
+            if (
+                self.drain_deadline_s > 0
+                and len(self._queue) < self.batch_max
+            ):
+                # Bounded linger so a trickle of writers can coalesce
+                # into one commit (off by default: commit latency
+                # already provides natural batching under load).
+                await asyncio.sleep(self.drain_deadline_s)
+            async with self._db._lock:
+                batch: list[_WriteUnit] = []
+                while self._queue and len(batch) < self.batch_max:
+                    unit = self._queue.popleft()
+                    self._sem.release()
+                    if not unit.future.done():  # caller gone: skip
+                        batch.append(unit)
+                if not batch:
+                    continue
+                self._inflight = batch
+                # Chaos: armed `db.drain` crashes/stalls the drainer in
+                # its worst window — batch popped, futures unresolved —
+                # proving the supervision above, not the happy path.
+                faults.fire("db.drain")
+                if not self._db._connected():
+                    err = DatabaseError("database not connected")
+                    for u in batch:
+                        u.future.set_exception(err)
+                    self._inflight = None
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    results = await self._db._run_write_group(batch)
+                except Exception as e:
+                    err = (
+                        e if isinstance(e, DatabaseError)
+                        else DatabaseError(str(e))
+                    )
+                    for u in batch:
+                        if not u.future.done():
+                            u.future.set_exception(err)
+                    self._inflight = None
+                    continue
+            ok_count = sum(1 for ok, _ in results if ok)
+            self._note(len(batch), ok_count, time.perf_counter() - t0)
+            for unit, (ok, payload) in zip(batch, results):
+                if unit.future.done():
+                    continue
+                if ok:
+                    unit.future.set_result(payload)
+                else:
+                    unit.future.set_exception(payload)
+            self._inflight = None
+            self._crash_streak = 0  # a full drain round heals the streak
+
+    def _note_crash(self, exc: Exception):
+        """Drain-loop crash: fail the in-flight batch + queue NOW (never
+        a hang), count the restart, back off with full jitter, and trip
+        the fail-fast latch once the restart budget is spent (a fresh
+        batcher from reconnect() resets it)."""
+        self._crash_streak += 1
+        self.drain_restarts += 1
+        err = DatabaseError(f"write drain crashed: {exc}")
+        inflight, self._inflight = self._inflight, None
+        for u in inflight or ():
+            if not u.future.done():
+                u.future.set_exception(err)
+        self.fail_pending(err)
+        metrics = self._db.metrics
+        if metrics is not None:
+            metrics.db_drain_restarts.labels(loop="write").inc()
+        tracing = self._db.tracing
+        if tracing is not None:
+            tracing.record_breaker(
+                kind="db_write_drain",
+                crash=str(exc),
+                streak=self._crash_streak,
+            )
+        if self._crash_streak > self.drain_restart_max:
+            self._broken = True
+        else:
+            self._resume_at = time.monotonic() + jittered_backoff(
+                self._crash_streak, DRAIN_BACKOFF_BASE_S,
+                DRAIN_BACKOFF_MAX_S,
+            )
 
     def _note(self, batch_len: int, ok_count: int, dt: float) -> None:
         self.group_commits += 1
@@ -280,6 +371,15 @@ class ReadCoalescer:
         self.batch_max = max(1, batch_max)
         self._queue: collections.deque[_ReadOp] = collections.deque()
         self._workers: dict[int, asyncio.Task | None] = {}
+        # Self-healing supervision (same discipline as WriteBatcher):
+        # chunks popped but unresolved per worker, crash backoff, and a
+        # restart ledger. Reads are idempotent so there is no fail-fast
+        # latch — a crashed worker fails its futures and the next run()
+        # re-kicks after the backoff.
+        self._inflight: dict[int, list[_ReadOp]] = {}
+        self._crash_streak = 0
+        self._resume_at = 0.0
+        self.drain_restarts = 0
 
     async def run(self, fn):
         loop = asyncio.get_running_loop()
@@ -296,79 +396,141 @@ class ReadCoalescer:
                 return  # one fresh worker per kick; queue growth re-kicks
 
     async def _drain(self, idx: int):
-        loop = asyncio.get_running_loop()
+        """Supervision shell around `_drain_chunks`: an escape (worker
+        bug, injected `db.read` fault) fails the popped chunk + queued
+        reads with DatabaseError — never a hang — counts a restart, and
+        backs off before the next worker touches the pool."""
         try:
-            while self._queue:
-                pool = len(self._db._readers)
-                if idx >= pool:
-                    return  # pool shrank (close): failed by fail_pending
-                ex, conn = self._db._readers[idx]
-                # Spread a burst over the WHOLE pool: cap this chunk at
-                # its fair share (ceil(queue/pool)) so 64 queued reads
-                # land ~16-per-connection, not 64 serialized on one.
-                limit = min(
-                    self.batch_max,
-                    max(1, -(-len(self._queue) // pool)),
-                )
-                batch: list[_ReadOp] = []
-                while self._queue and len(batch) < limit:
-                    op = self._queue.popleft()
-                    if not op.future.done():
-                        batch.append(op)
-                if not batch:
-                    return
-
-                def _chunk():
-                    # Gauge per FETCH, not per chunk: the chunk queues
-                    # on one connection, so true concurrency is the
-                    # number of busy reader threads, not burst size.
-                    out = []
-                    gauge = None
-                    for op in batch:
-                        g = self._db._note_reads(1)
-                        try:
-                            try:
-                                out.append((True, op.fn(conn)))
-                            except Exception as e:
-                                out.append((False, e))
-                        finally:
-                            self._db._note_reads(-1)
-                        if g is not None:
-                            gauge = g
-                    return out, gauge
-
-                try:
-                    results, gauge = await loop.run_in_executor(ex, _chunk)
-                except Exception as e:
-                    # Executor shut down mid-drain (close racing reads):
-                    # resolve the popped futures instead of abandoning
-                    # their callers to await forever.
-                    err = (
-                        e if isinstance(e, DatabaseError)
-                        else DatabaseError(str(e))
-                    )
-                    for op in batch:
-                        if not op.future.done():
-                            op.future.set_exception(err)
-                    continue
-                metrics = self._db.metrics
-                if metrics is not None and gauge is not None:
-                    metrics.db_peak_concurrent_reads.set(gauge)
-                for op, (ok, payload) in zip(batch, results):
-                    if op.future.done():
-                        continue
-                    if ok:
-                        op.future.set_result(payload)
-                    elif isinstance(payload, sqlite3.Error):
-                        op.future.set_exception(
-                            self._db._map_sqlite_error(payload)
-                        )
-                    else:
-                        op.future.set_exception(payload)
+            await self._drain_chunks(idx)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._note_crash(idx, e)
         finally:
+            self._inflight.pop(idx, None)
             self._workers[idx] = None
             if self._queue:  # a run() raced this worker's shutdown
                 self._kick(asyncio.get_running_loop())
+
+    def _note_crash(self, idx: int, exc: Exception):
+        self._crash_streak += 1
+        self.drain_restarts += 1
+        err = DatabaseError(f"read drain crashed: {exc}")
+        for op in self._inflight.pop(idx, ()):
+            if not op.future.done():
+                op.future.set_exception(err)
+        self.fail_pending(err)
+        self._resume_at = time.monotonic() + jittered_backoff(
+            self._crash_streak, DRAIN_BACKOFF_BASE_S, DRAIN_BACKOFF_MAX_S
+        )
+        metrics = self._db.metrics
+        if metrics is not None:
+            metrics.db_drain_restarts.labels(loop="read").inc()
+        tracing = self._db.tracing
+        if tracing is not None:
+            tracing.record_breaker(
+                kind="db_read_drain",
+                crash=str(exc),
+                streak=self._crash_streak,
+            )
+
+    async def _drain_chunks(self, idx: int):
+        loop = asyncio.get_running_loop()
+        if self._resume_at:
+            delay = self._resume_at - time.monotonic()
+            self._resume_at = 0.0
+            if delay > 0:
+                await asyncio.sleep(delay)
+        while self._queue:
+            pool = len(self._db._readers)
+            if idx >= pool:
+                return  # pool shrank (close): failed by fail_pending
+            ex, conn = self._db._readers[idx]
+            # Spread a burst over the WHOLE pool: cap this chunk at
+            # its fair share (ceil(queue/pool)) so 64 queued reads
+            # land ~16-per-connection, not 64 serialized on one.
+            limit = min(
+                self.batch_max,
+                max(1, -(-len(self._queue) // pool)),
+            )
+            batch: list[_ReadOp] = []
+            while self._queue and len(batch) < limit:
+                op = self._queue.popleft()
+                if not op.future.done():
+                    batch.append(op)
+            if not batch:
+                return
+            self._inflight[idx] = batch
+            # Chaos: armed `db.read` crashes/stalls this worker with
+            # the chunk popped — the supervision shell must fail the
+            # futures, never abandon them.
+            faults.fire("db.read")
+
+            def _chunk():
+                # Gauge per FETCH, not per chunk: the chunk queues
+                # on one connection, so true concurrency is the
+                # number of busy reader threads, not burst size.
+                out = []
+                gauge = None
+                wedged = False
+                for op in batch:
+                    g = self._db._note_reads(1)
+                    try:
+                        try:
+                            out.append((True, op.fn(conn)))
+                        except Exception as e:
+                            if isinstance(e, sqlite3.ProgrammingError):
+                                # "Cannot operate on a closed
+                                # database" and kin: the CONNECTION
+                                # is wedged, not the query — flag it
+                                # for an in-place reopen.
+                                wedged = True
+                            out.append((False, e))
+                    finally:
+                        self._db._note_reads(-1)
+                    if g is not None:
+                        gauge = g
+                return out, gauge, wedged
+
+            try:
+                results, gauge, wedged = await loop.run_in_executor(
+                    ex, _chunk
+                )
+            except Exception as e:
+                # Executor shut down mid-drain (close racing reads):
+                # resolve the popped futures instead of abandoning
+                # their callers to await forever.
+                err = (
+                    e if isinstance(e, DatabaseError)
+                    else DatabaseError(str(e))
+                )
+                for op in batch:
+                    if not op.future.done():
+                        op.future.set_exception(err)
+                self._inflight.pop(idx, None)
+                continue
+            metrics = self._db.metrics
+            if metrics is not None and gauge is not None:
+                metrics.db_peak_concurrent_reads.set(gauge)
+            for op, (ok, payload) in zip(batch, results):
+                if op.future.done():
+                    continue
+                if ok:
+                    op.future.set_result(payload)
+                elif isinstance(payload, sqlite3.Error):
+                    op.future.set_exception(
+                        self._db._map_sqlite_error(payload)
+                    )
+                else:
+                    op.future.set_exception(payload)
+            self._inflight.pop(idx, None)
+            self._crash_streak = 0
+            if wedged and not getattr(self._db, "_closing", False):
+                # Self-heal the wedged connection in place: the ops
+                # already failed to their callers (reads retry
+                # cheaply); the REOPEN is what restores the pool for
+                # everyone after.
+                await self._db._reopen_reader(idx)
 
     def fail_pending(self, exc: Exception):
         """Resolve every still-queued read with `exc` (close path: the
@@ -438,6 +600,7 @@ class Database(GroupCommitObservability):
         write_batch_max: int = WRITE_BATCH_MAX,
         write_queue_depth: int = WRITE_QUEUE_DEPTH,
         write_drain_deadline_ms: int = WRITE_DRAIN_DEADLINE_MS,
+        db_drain_restart_max: int = DB_DRAIN_RESTART_MAX,
     ):
         # Multi-address failover seam (reference DbConnect db.go:35 tries
         # each DSN in order): the first address that opens wins.
@@ -468,9 +631,14 @@ class Database(GroupCommitObservability):
         self.group_commit = bool(group_commit)
         self._write_knobs = (
             write_batch_max, write_queue_depth, write_drain_deadline_ms,
+            db_drain_restart_max,
         )
         self._batcher = WriteBatcher(self, *self._write_knobs)
         self._read_coalescer = ReadCoalescer(self)
+        # Shutdown-under-load latch: set first thing in close() so new
+        # submits reject immediately and queued-but-undrained units fail
+        # with DatabaseError instead of hanging their awaiters.
+        self._closing = False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -493,9 +661,11 @@ class Database(GroupCommitObservability):
             )
         # Fresh batcher + coalescer per connect (matching pg.py): their
         # asyncio primitives bind to the loop they first run on, and a
-        # reconnect may be on a new loop.
+        # reconnect may be on a new loop. This also resets the drain
+        # supervisors' crash streaks and the fail-fast latch.
         self._batcher = WriteBatcher(self, *self._write_knobs)
         self._read_coalescer = ReadCoalescer(self)
+        self._closing = False
         last_error: Exception | None = None
         for path in self.addresses:
             try:
@@ -523,29 +693,60 @@ class Database(GroupCommitObservability):
         ):
             return
 
-        def _open_ro():
-            conn = sqlite3.connect(
-                f"file:{self.path}?mode=ro", uri=True,
-                check_same_thread=False,
-            )
-            conn.row_factory = sqlite3.Row
-            return conn
-
         loop = asyncio.get_running_loop()
         for i in range(self._read_pool_size):
             ex = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix=f"nakama-db-r{i}"
             )
             try:
-                conn = await loop.run_in_executor(ex, _open_ro)
+                conn = await loop.run_in_executor(ex, self._open_ro_conn)
             except sqlite3.Error:
                 ex.shutdown(wait=False)
                 break  # reads fall back to the writer path
             self._readers.append((ex, conn))
 
+    def _open_ro_conn(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(
+            f"file:{self.path}?mode=ro", uri=True,
+            check_same_thread=False,
+        )
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    async def _reopen_reader(self, idx: int) -> None:
+        """Self-heal one wedged reader connection in place (called by
+        the coalescer when a chunk hit connection-level errors): close
+        the dead handle on its own executor thread and open a fresh
+        read-only connection there. Best-effort — a failed reopen
+        leaves the old handle in place and the next wedged chunk
+        retries it."""
+        if idx >= len(self._readers):
+            return
+        ex, conn = self._readers[idx]
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(ex, conn.close)
+        except Exception:
+            pass
+        try:
+            fresh = await loop.run_in_executor(ex, self._open_ro_conn)
+        except (sqlite3.Error, RuntimeError):
+            return
+        if idx < len(self._readers) and self._readers[idx][0] is ex:
+            self._readers[idx] = (ex, fresh)
+        if self.tracing is not None:
+            self.tracing.record_breaker(
+                kind="db_reader_reopen", reader=idx
+            )
+
     async def close(self) -> None:
-        # Let in-flight group commits finish so already-awaited writes
-        # resolve rather than dying with the connection.
+        # Shutdown under load: queued-but-undrained units REJECT with
+        # DatabaseError now (their awaiters resolve immediately), new
+        # submits reject via the closing latch, and only the batch the
+        # drainer already popped rides its commit to completion — so
+        # close() is bounded by one group commit, not the whole queue.
+        self._closing = True
+        self._batcher.fail_pending(DatabaseError("database closing"))
         await self._batcher.flush()
         # Take the lock so we never close under an open transaction.
         async with self._lock:

@@ -34,13 +34,17 @@ import asyncio
 import hashlib
 import hmac
 import os
+import random
 import re
 import struct
 from base64 import b64decode, b64encode
 from typing import Any, Iterable
 from urllib.parse import unquote, urlparse
 
+from .. import faults
+from ..faults import CircuitBreaker, jittered_backoff
 from .db import (
+    DB_DRAIN_RESTART_MAX,
     DatabaseError,
     GroupCommitObservability,
     UniqueViolationError,
@@ -49,6 +53,20 @@ from .db import (
     _normalize_unit,
 )
 from .migrations import MIGRATIONS
+
+# Pre-COMMIT connection-loss retry budget (jittered exponential backoff,
+# faults.py jittered_backoff): attempts beyond this fail the batch to
+# its callers with DatabaseError instead of reconnect-storming a dead
+# server. The writer breaker counts BATCH OUTCOMES (not individual
+# connection attempts — a batch that retried twice and committed is one
+# success): after PG_BREAKER_THRESHOLD consecutive failed batches it
+# opens and writes fail FAST until a cooldown probe reconnects — the
+# same ladder the matchmaker device path runs.
+PG_WRITE_RETRY_MAX = 3
+PG_RETRY_BASE_S = 0.05
+PG_RETRY_MAX_S = 1.0
+PG_BREAKER_THRESHOLD = 3
+PG_BREAKER_COOLDOWN_S = 1.0
 
 
 def scram_client_final(
@@ -471,6 +489,7 @@ class PostgresDatabase(GroupCommitObservability):
         write_batch_max: int = 256,
         write_queue_depth: int = 4096,
         write_drain_deadline_ms: int = 0,
+        db_drain_restart_max: int = DB_DRAIN_RESTART_MAX,
     ):
         self.addresses = [dsn] if isinstance(dsn, str) else list(dsn)
         self.path = self.addresses[0]
@@ -491,11 +510,29 @@ class PostgresDatabase(GroupCommitObservability):
         self.group_commit = bool(group_commit)
         self._write_knobs = (
             write_batch_max, write_queue_depth, write_drain_deadline_ms,
+            db_drain_restart_max,
         )
         self._batcher = WriteBatcher(self, *self._write_knobs)
+        self._closing = False
+        # Writer-connection breaker (degradation ladder): consecutive
+        # connection losses open it and group writes fail fast instead
+        # of each batch paying the full reconnect-retry budget against
+        # a dead server; a cooldown probe (the next batch) closes it.
+        self._breaker = CircuitBreaker(
+            threshold=PG_BREAKER_THRESHOLD,
+            cooldown_s=PG_BREAKER_COOLDOWN_S,
+            on_transition=self._on_breaker_transition,
+        )
+        self._retry_rng = random.Random()
 
     def _connected(self) -> bool:
         return self._conn is not None
+
+    def _on_breaker_transition(self, old: str, new: str, reason: str):
+        if self.tracing is not None:
+            self.tracing.record_breaker(
+                kind="pg_writer", old=old, new=new, reason=reason
+            )
 
     @staticmethod
     def _parse(dsn: str):
@@ -516,7 +553,9 @@ class PostgresDatabase(GroupCommitObservability):
     async def connect(self, migrate: bool = True) -> None:
         # Fresh batcher per connect: its asyncio primitives bind to the
         # loop they first run on, and a reconnect may be on a new loop.
+        # (Also resets the drain supervisor's fail-fast latch.)
         self._batcher = WriteBatcher(self, *self._write_knobs)
+        self._closing = False
         last: Exception | None = None
         for dsn in self.addresses:
             try:
@@ -537,7 +576,10 @@ class PostgresDatabase(GroupCommitObservability):
                 break  # degraded: reads fall back to the writer
 
     async def close(self) -> None:
-        # Drain in-flight group commits so awaited writes resolve.
+        # Shutdown under load mirrors the SQLite engine: queued units
+        # reject with DatabaseError now, the in-flight batch finishes.
+        self._closing = True
+        self._batcher.fail_pending(DatabaseError("database closing"))
         await self._batcher.flush()
         for c in [self._conn, *self._readers]:
             if c is not None:
@@ -699,31 +741,86 @@ class PostgresDatabase(GroupCommitObservability):
         error otherwise).
 
         Connection loss (server restart, LB idle kill) reconnects
-        across the configured addresses and retries the whole group
-        ONCE — the same seam `_writer_query` gives the legacy path —
-        but ONLY when the loss happened before COMMIT was sent, which
-        is the only point retry is provably safe. A socket death during
-        the COMMIT query itself leaves the outcome unknown on the
-        server, and retrying a whole batch would multiply the
-        double-apply exposure across every caller sharing the commit —
-        those units fail to their callers with an explicit
+        across the configured addresses and retries the group with a
+        bounded jittered-backoff budget (PG_WRITE_RETRY_MAX) — the
+        failover seam `_writer_query` gives the legacy path, hardened
+        for the batched one — but ONLY when the loss happened before
+        COMMIT was sent, which is the only point retry is provably
+        safe. A socket death during the COMMIT query itself leaves the
+        outcome unknown on the server, and retrying a whole batch would
+        multiply the double-apply exposure across every caller sharing
+        the commit — those units fail to their callers with an explicit
         commit-state-unknown error instead. Likewise once the per-unit
         SOLO fallback starts committing, a loss fails the remaining
-        units rather than re-running units already made durable."""
-        try:
-            return await self._run_group_once(units)
-        except _CommitAckLost as e:
-            try:
-                await self._reconnect_writer()
-            except Exception:
-                pass  # next write retries via this method
+        units rather than re-running units already made durable.
+
+        The writer breaker wires the same degradation ladder as the
+        matchmaker device path: consecutive losses open it and batches
+        fail FAST (one DatabaseError, no reconnect storm) until the
+        cooldown probe — the next batch — reconnects and closes it."""
+        if not self._breaker.allow():
             err = DatabaseError(
-                f"connection lost during commit (outcome unknown): {e}"
+                "database writer circuit open (recent connection losses);"
+                " retry after cooldown"
             )
             return [(False, err) for _ in units]
-        except (OSError, asyncio.IncompleteReadError):
-            await self._reconnect_writer()
-            return await self._run_group_once(units)
+        # The breaker records one outcome per BATCH (success after
+        # retries is a success): recording every connection attempt
+        # could open it mid-retry-loop and then discard the batch's own
+        # success as stale, failing healthy writes for a full cooldown.
+        attempt = 0
+        while True:
+            if self._conn is None:
+                try:
+                    await self._reconnect_writer()
+                except Exception as e:
+                    self._breaker.record_failure()
+                    err = DatabaseError(f"no database address reachable: {e}")
+                    return [(False, err) for _ in units]
+            try:
+                results = await self._run_group_once(units)
+            except _CommitAckLost as e:
+                self._breaker.record_failure()
+                try:
+                    await self._reconnect_writer()
+                except Exception:
+                    pass  # next write retries via this method
+                err = DatabaseError(
+                    f"connection lost during commit (outcome unknown): {e}"
+                )
+                return [(False, err) for _ in units]
+            except (OSError, asyncio.IncompleteReadError) as e:
+                attempt += 1
+                if attempt > PG_WRITE_RETRY_MAX:
+                    self._breaker.record_failure()
+                    # Never leave the half-applied transaction's
+                    # connection behind: the next batch's BEGIN would
+                    # land inside it. Dropping the connection rolls the
+                    # server side back.
+                    try:
+                        await self._reconnect_writer()
+                    except Exception:
+                        self._conn = None
+                    err = DatabaseError(
+                        f"connection lost before COMMIT; retries"
+                        f" exhausted: {e}"
+                    )
+                    return [(False, err) for _ in units]
+                # Pre-COMMIT loss: the server-side transaction died with
+                # the socket, so a re-run cannot double-apply. Full
+                # jitter decorrelates the reconnect stampede when many
+                # engines lose the same server at once.
+                await asyncio.sleep(jittered_backoff(
+                    attempt, PG_RETRY_BASE_S, PG_RETRY_MAX_S,
+                    rng=self._retry_rng,
+                ))
+                try:
+                    await self._reconnect_writer()
+                except Exception:
+                    self._conn = None  # next loop pass retries/charges
+                continue
+            self._breaker.record_success()
+            return results
 
     @staticmethod
     async def _apply_unit_stmts(conn, stmts, guards) -> list[int]:
@@ -812,6 +909,13 @@ class PostgresDatabase(GroupCommitObservability):
             except Exception:
                 pass
             raise
+        # Chaos: `pg.commit` injects a connection loss at the sharpest
+        # retry-safe seam — every unit applied, COMMIT not yet sent (a
+        # pre-COMMIT drop: the server-side transaction dies with the
+        # socket, so the bounded retry above re-runs without
+        # double-apply). A loss DURING the COMMIT round trip below is
+        # the ambiguous case and fails the batch instead.
+        faults.fire("pg.commit")
         try:
             await conn.query("COMMIT")
         except (OSError, asyncio.IncompleteReadError) as e:

@@ -32,6 +32,11 @@ class Tracing:
         # Group-commit drain spans from the storage write batcher
         # (record_db_drain): batch size / drain time / queue depth.
         self.db_drains: deque[dict] = deque(maxlen=capacity)
+        # Degradation-ladder transitions (faults.py CircuitBreaker) and
+        # reclamation events: breaker open/half-open/closed flips plus
+        # in-flight cohort reclamations, so an operator can read the
+        # outage timeline off the ledger instead of correlating logs.
+        self.breaker_events: deque[dict] = deque(maxlen=capacity)
         if port:
             self.start_profiler_server(port)
 
@@ -107,3 +112,14 @@ class Tracing:
 
     def recent_db_drains(self, n: int = 32) -> list[dict]:
         return list(self.db_drains)[-n:]
+
+    # ------------------------------------------------ degradation ladder
+
+    def record_breaker(self, **fields):
+        """One breaker transition or reclamation event (matchmaker
+        backend / storage drains): state flip, reason, and counts."""
+        fields.setdefault("ts", time.time())
+        self.breaker_events.append(fields)
+
+    def recent_breaker_events(self, n: int = 32) -> list[dict]:
+        return list(self.breaker_events)[-n:]

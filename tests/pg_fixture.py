@@ -45,6 +45,12 @@ class FakePgServer:
         self._server: asyncio.AbstractServer | None = None
         self.port: int | None = None
         self.queries: list[str] = []
+        # Which client session owns the open transaction on the shared
+        # sqlite connection: real Postgres rolls an open transaction
+        # back when its connection dies, and the engine's pre-COMMIT
+        # retry seam depends on exactly that — a disconnected client's
+        # half-applied group must vanish, not poison the next BEGIN.
+        self._tx_owner: object | None = None
 
     async def start(self):
         self._server = await asyncio.start_server(
@@ -62,12 +68,23 @@ class FakePgServer:
     # ------------------------------------------------------------- session
 
     async def _client(self, r: asyncio.StreamReader, w: asyncio.StreamWriter):
+        token = object()
         try:
             await self._handshake(r, w)
-            await self._serve(r, w)
+            await self._serve(r, w, token)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
+            if self._tx_owner is token:
+                # Faithful disconnect semantics: the dead client's open
+                # transaction rolls back (Postgres does this when the
+                # backend process dies with the socket).
+                self._tx_owner = None
+                try:
+                    if self.conn.in_transaction:
+                        self.conn.rollback()
+                except sqlite3.Error:
+                    pass
             w.close()
 
     async def _handshake(self, r, w):
@@ -179,7 +196,7 @@ class FakePgServer:
 
     # -------------------------------------------------------------- queries
 
-    async def _serve(self, r, w):
+    async def _serve(self, r, w, token=None):
         stmt_sql = ""
         bound: tuple = ()
         while True:
@@ -189,7 +206,7 @@ class FakePgServer:
             if tag == b"Q":
                 sql = body.rstrip(b"\0").decode()
                 self.queries.append(sql)
-                await self._run(w, sql, (), simple=True)
+                await self._run(w, sql, (), simple=True, owner=token)
                 w.write(_msg(b"Z", b"I"))
                 await w.drain()
             elif tag == b"P":
@@ -219,18 +236,26 @@ class FakePgServer:
             elif tag == b"D":
                 pass  # description rides the Execute response
             elif tag == b"E":
-                await self._run(w, stmt_sql, bound)
+                await self._run(w, stmt_sql, bound, owner=token)
             elif tag == b"S":
                 w.write(_msg(b"Z", b"I"))
                 await w.drain()
             # others ignored
 
-    async def _run(self, w, sql, params, simple=False):
+    async def _run(self, w, sql, params, simple=False, owner=None):
         sqlite_sql = re.sub(r"\$(\d+)", "?", sql)
         py_params = [self._coerce(sql, i, p) for i, p in enumerate(params)]
         try:
             cur = self.conn.execute(sqlite_sql, py_params)
             rows = cur.fetchall() if cur.description else []
+            head = sql.lstrip().upper()
+            if head.startswith("BEGIN"):
+                self._tx_owner = owner
+            elif head.startswith("COMMIT") or (
+                head.startswith("ROLLBACK")
+                and not head.startswith("ROLLBACK TO")
+            ):
+                self._tx_owner = None
         except sqlite3.IntegrityError as e:
             code = (
                 "23505" if "UNIQUE constraint failed" in str(e) else "23000"
