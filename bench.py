@@ -230,8 +230,14 @@ def measure_device(
     `latency_sample` > 0 additionally measures TRUE matchmaking latency —
     ticket-add wall-clock to matched-callback wall-clock — for every
     latency_sample'th ticket (VERDICT r2 #4: per-interval Process()
-    timing alone hides the pipelined collection lag).
+    timing alone hides the pipelined collection lag). Sampled intervals
+    deliver EVENT-DRIVEN, as the production delivery stage does: each
+    cohort is collected the moment its worker signals completion, so
+    the samples measure the pipeline itself, not the distance to the
+    next collection point.
     """
+    import threading
+
     from nakama_tpu.logger import test_logger
     from nakama_tpu.matchmaker import LocalMatchmaker
 
@@ -257,6 +263,8 @@ def measure_device(
     mm = LocalMatchmaker(
         test_logger(), cfg, backend=backend, on_matched=on_matched
     )
+    ready_evt = threading.Event()
+    backend.set_ready_callback(ready_evt.set)
     # Same GC posture as the production interval loop (local.py _loop):
     # the gap's explicit collect owns gen2; an automatic gen2 pass costs
     # 100-650ms at this heap size and would land mid-interval.
@@ -311,6 +319,16 @@ def measure_device(
         # reference config.go:973) of idle gap, where the pipelined device
         # pass completes and the interval loop runs gc (matchmaker/local
         # _loop). Model the gap by those completion points, untimed.
+        if sampling:
+            # Event-driven mid-gap delivery (local.py _delivery_loop):
+            # ship each cohort at its completion signal. Non-sampled
+            # intervals keep the old collect-at-next-process shape so
+            # the timed p99 region is unchanged.
+            settle = time.monotonic() + 60
+            while backend.pipeline_depth() and time.monotonic() < settle:
+                ready_evt.wait(1.0)
+                ready_evt.clear()
+                mm.collect_pipelined()
         backend.wait_idle()
         mm.store.drain()
         gc.collect()
@@ -325,18 +343,24 @@ def measure_cadence_latency(rng, pool, cadence_sec, cycles):
     """Pipeline DELIVERY latency at a real interval cadence: wall-clock
     from a ticket's add (stamped just before its dispatching process())
     to its matched callback, replaying the production loop's schedule
-    (head-gap drain/gc/flush, mid-gap pipelined collection at fixed
-    points in the gap — matchmaker/local.py _loop). This is the lag the
-    PIPELINE adds on top of the wait-to-dispatch; a worst-case arrival
-    (just after the previous process) waits up to interval_sec more, so
-    worst-case add→matched = cadence_sec + this. Returns (p50_ms,
-    p99_ms, samples)."""
+    (head-gap drain/gc/flush, then EVENT-DRIVEN delivery — the cohort's
+    worker thread signals completion and collection runs immediately,
+    exactly as matchmaker/local.py's delivery stage does; the deadline
+    guard and watchdog are the same timed fallbacks). This is the lag
+    the PIPELINE adds on top of the wait-to-dispatch; a worst-case
+    arrival (just after the previous process) waits up to interval_sec
+    more, so worst-case add→matched = cadence_sec + this. Returns
+    (p50_ms, p99_ms, samples)."""
+    import threading
+
     from nakama_tpu.logger import test_logger
     from nakama_tpu.matchmaker import LocalMatchmaker
 
     cfg, backend = _mk_backend(pool, interval_sec=int(cadence_sec))
     add_time = {}
     latencies = []
+    ready_evt = threading.Event()
+    backend.set_ready_callback(ready_evt.set)
 
     def on_matched(batch):
         now = time.perf_counter()
@@ -396,47 +420,78 @@ def measure_cadence_latency(rng, pool, cadence_sec, cycles):
             measure_wall_t0 = time.time()
         t0 = time.perf_counter()
         mm.process()  # dispatches the just-stamped tickets
-        # The production gap schedule (local.py _loop) on absolute
-        # deadlines from the dispatch: head-gap, then gap work UNLESS an
-        # unfinished cohort needs the core (backpressure shed), then
-        # ~1s-granularity collection polls that wake early for a cohort
-        # approaching its delivery deadline and block-join it at guard
-        # time so it ships before its own interval ends.
+        # The production gap schedule (local.py _loop + _delivery_loop)
+        # on absolute deadlines from the dispatch: head-gap, then gap
+        # work UNLESS an unfinished cohort needs the core (backpressure
+        # shed), then EVENT-DRIVEN delivery — wait on the completion
+        # signal (watchdog-bounded), wake early for a cohort
+        # approaching its delivery deadline, and guard-join it once so
+        # it ships before its own interval ends.
         gap = min(2.0, cadence_sec / 4)
         interval_end = t0 + cadence_sec
+        maintenance_at = t0 + gap  # local.py's head-gap work point
+        maintenance_done = False
         guard = max(0.1, cfg.pipeline_deadline_guard_sec)
-        time.sleep(max(0.0, t0 + gap - time.perf_counter()))
-        backlogged = getattr(backend, "pipeline_backlogged", None)
-        if backlogged is not None and backlogged() and shed_streak < 2:
-            shed_streak += 1  # shed gap work: delivery preempts maintenance
-        else:
-            shed_streak = 0
-            dl = backend.next_deadline()
-            # Floor the drain budget (as in local.py): a past deadline
-            # must not starve maintenance out of every forced gap.
-            mm.store.drain(
-                None
-                if dl is None
-                else max(time.perf_counter() + 0.2, dl - guard)
-            )
-            gc.collect()
-            backend.pool.flush()
+        watchdog = max(0.05, float(cfg.delivery_watchdog_sec))
+        guard_joined = None
         while time.perf_counter() < interval_end - 0.05:
             now = time.perf_counter()
-            wake = min(interval_end - 0.02, now + 1.0)
+            wait = min(interval_end - 0.02 - now, watchdog)
+            if not maintenance_done:
+                wait = min(wait, max(0.0, maintenance_at - now))
             dl = backend.next_deadline()
-            if dl is not None:
-                # Floored + forward-looking bounds as in local.py: an
-                # overdue unfinished head must block in the join, not
-                # busy-spin against its own assembly thread.
-                wake = min(wake, max(now + 0.05, dl - guard))
-            time.sleep(max(0.0, wake - time.perf_counter()))
+            if dl is not None and dl - guard > now:
+                wait = min(wait, dl - guard - now)
+            if wait > 0:
+                # Event-driven: the cohort's worker thread sets the
+                # event the moment assembly finishes — delivery runs
+                # milliseconds later, DURING the head-gap too (the
+                # production delivery task is independent of the
+                # interval task's sleep), instead of queuing behind
+                # gap work and a poll schedule.
+                ready_evt.wait(wait)
+            ready_evt.clear()
             dl = backend.next_deadline()
             if dl is not None and time.perf_counter() >= dl - guard:
-                backend.join_head(
-                    max(dl + guard, time.perf_counter() + 0.25)
-                )
+                token = backend.head_token()
+                if not backend.head_ready() and token != guard_joined:
+                    # Once per head (join_head itself refuses to block
+                    # past deadline+guard); a head that failed its one
+                    # guard join is wedged — the reclaim path's business.
+                    guard_joined = token
+                    backend.join_head(
+                        max(dl + guard, time.perf_counter() + 0.25)
+                    )
+                if time.perf_counter() > dl:
+                    backend.reclaim_stale()
             mm.collect_pipelined()
+            if (
+                not maintenance_done
+                and time.perf_counter() >= maintenance_at
+            ):
+                # The gap maintenance at its scheduled point — after
+                # any due delivery (delivery preempts maintenance).
+                maintenance_done = True
+                backlogged = getattr(backend, "pipeline_backlogged", None)
+                if (
+                    backlogged is not None
+                    and backlogged()
+                    and shed_streak < 2
+                ):
+                    shed_streak += 1  # shed: delivery preempts gap work
+                else:
+                    shed_streak = 0
+                    dl = backend.next_deadline()
+                    # Floor the drain budget (as in local.py): a past
+                    # deadline must not starve maintenance out of every
+                    # forced gap.
+                    mm.store.drain(
+                        None
+                        if dl is None
+                        else max(time.perf_counter() + 0.2, dl - guard)
+                    )
+                    gc.collect()
+                    backend.pool.flush()
         time.sleep(max(0.0, interval_end - time.perf_counter()))
         if sampling:
             # Per-cycle delivery stats (VERDICT r4 #3): one bad cycle
@@ -478,7 +533,9 @@ def measure_cadence_latency(rng, pool, cadence_sec, cycles):
         for d in backend.tracing.recent_deliveries(100_000)
         if d.get("slipped")
         and measure_wall_t0 is not None
-        and (d["ts"] - d["collect_lag_s"]) >= measure_wall_t0 - 0.05
+        and (
+            d.get("dispatched_ts") or (d["ts"] - d["collect_lag_s"])
+        ) >= measure_wall_t0 - 0.05
     )
     mm.stop()
     gc.set_threshold(g0, g1, g2_saved)
@@ -492,6 +549,23 @@ def measure_cadence_latency(rng, pool, cadence_sec, cycles):
         per_cycle,
         cohorts_slipped,
     )
+
+
+def cadence_regression(per_cycle, cohorts_slipped, cadence_sec):
+    """The cadence slip gate (PR 1's contract, restored as a named,
+    tier-1-tested function so it cannot silently rot again): ANY
+    measured cycle whose slowest delivery exceeded the cadence, or ANY
+    cohort the backend ledger stamped slipped, is a regression — the
+    bench must emit "regression": true AND exit nonzero, so a driver
+    keeping only rc or only the tail can never average a 34s cycle
+    away. Returns (slipped_cycle_count, regression)."""
+    slipped = sum(
+        1
+        for c in per_cycle
+        if c.get("max_ms") is not None
+        and c["max_ms"] > cadence_sec * 1000
+    )
+    return slipped, bool(slipped or cohorts_slipped)
 
 
 def measure_write_load(rng, pool, intervals=5, percommit_intervals=2):
@@ -1150,7 +1224,10 @@ def main():
                     "note": (
                         "wall-clock ticket-add to matched-callback"
                         " at bench cadence (gap = pipeline drain,"
-                        " not the production 15s IntervalSec)"
+                        " not the production 15s IntervalSec);"
+                        " event-driven delivery — each cohort ships"
+                        " at its completion signal, not at the next"
+                        " collection point"
                     ),
                 }
             )
@@ -1206,12 +1283,9 @@ def main():
         p50, p99l, n, per_cycle, cohorts_slipped = measure_cadence_latency(
             rng, NS_POOL, cadence, cycles
         )
-        slipped = sum(
-            1
-            for c in per_cycle
-            if c["max_ms"] is not None and c["max_ms"] > cadence * 1000
+        slipped, regression = cadence_regression(
+            per_cycle, cohorts_slipped, cadence
         )
-        regression = bool(slipped or cohorts_slipped)
         emit_json(
             {
                 "metric": "matchmaker_pipeline_delivery_at_"

@@ -137,13 +137,13 @@ class MatchmakerConfig:
     # hiding device+transfer latency entirely (100k-pool Process p99 is
     # ~20 ms pipelined vs ~1.5 s synchronous). Ticket properties are
     # immutable so candidate eligibility cannot go stale; removed tickets
-    # are filtered at collection. A matched cohort delivers mid-gap as
-    # soon as its device pass + host assembly finish (normally seconds
-    # after dispatch), and every cohort carries a delivery deadline of
-    # one interval_sec: the interval loop preempts idle-gap work
-    # (GC/drain/flush) to block-join a cohort nearing its deadline, so a
-    # cohort is delivered before its own interval ends instead of
-    # slipping behind gap work. Set False for the synchronous reference
+    # are filtered at collection. A matched cohort delivers the moment
+    # its device pass + host assembly finish: the worker thread signals
+    # the event-driven delivery stage (delivery_event_driven below),
+    # and every cohort carries a delivery deadline of one interval_sec
+    # backed by a deadline-guard join and the reclaim path, so a cohort
+    # is delivered before its own interval ends instead of slipping
+    # behind gap work. Set False for the synchronous reference
     # semantics (same-interval delivery, device pass on the critical
     # path) — kept as the explicit fallback and correctness oracle.
     interval_pipelining: bool = True
@@ -160,10 +160,27 @@ class MatchmakerConfig:
     # the sequential assembler's (oldest-first priority is preserved).
     device_pairing: bool = True
     # Seconds before a pipelined cohort's delivery deadline at which the
-    # interval loop stops polling and block-joins the cohort's assembly
-    # (yielding the core to it). Bounds the worst-case delivery lag at
-    # interval_sec + this guard's overrun allowance.
+    # delivery stage block-joins the cohort's assembly (yielding the
+    # core to it, once per head). Bounds the worst-case delivery lag at
+    # interval_sec + this guard's overrun allowance; join_head also
+    # refuses to block past deadline + guard, so a wedged head costs
+    # the guard at most one bounded join before the reclaim path
+    # (inflight_reclaim_deadline_ms) takes it.
     pipeline_deadline_guard_sec: float = 2.0
+    # Event-driven delivery stage (local.py _delivery_loop): the worker
+    # thread that finishes a cohort's device pass + assembly signals
+    # the event loop directly (call_soon_threadsafe), so accept →
+    # finalize → publish run within milliseconds of readiness instead
+    # of at the next gap poll — the poll-quantized multi-second
+    # dispatch→matched tail at production cadence was exactly this
+    # wait. False disables the wakeup; delivery then paces on the
+    # watchdog below (poll-quantized fallback, the pre-event behavior).
+    delivery_event_driven: bool = True
+    # Delivery-stage watchdog poll cadence (seconds): the timed drain
+    # that runs even if a completion signal is lost or the backend has
+    # no signal to offer. With event-driven wakeups on, this bounds
+    # recovery from a lost signal — it is NOT the delivery latency.
+    delivery_watchdog_sec: float = 1.0
     # Per-interval cap on host-only actives run through the CPU oracle
     # fallback (exotic queries the device kernel can't express). The
     # fallback is O(actives x pool) Python; without a cap a hostile or

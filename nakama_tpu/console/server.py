@@ -669,20 +669,34 @@ class ConsoleServer:
         return web.json_response({"matches": matches})
 
     async def _h_matchmaker(self, request: web.Request):
-        """Matchmaker observability: pool gauges + the per-interval device
-        timing breadcrumbs (SURVEY §5)."""
+        """Matchmaker observability: pool gauges, the per-interval device
+        timing breadcrumbs (SURVEY §5), and the per-cohort delivery
+        ledger with its per-stage attribution (dispatched→fetched→
+        ready→collected→accepted→published) — a delivery-gap regression
+        names its stage from this one endpoint."""
         self._auth(request)
         mm = self.server.matchmaker
         tracing = getattr(mm.backend, "tracing", None)
+        n = int(request.query.get("n", 32))
         return web.json_response(
             {
                 "tickets": len(mm),
                 "active": len(mm.active),
                 "backend": type(mm.backend).__name__,
                 "intervals": (
-                    tracing.recent(int(request.query.get("n", 32)))
+                    tracing.recent(n) if tracing is not None else []
+                ),
+                "deliveries": (
+                    tracing.recent_deliveries(n)
                     if tracing is not None
+                    and hasattr(tracing, "recent_deliveries")
                     else []
+                ),
+                "delivery_stages": (
+                    tracing.delivery_stage_stats()
+                    if tracing is not None
+                    and hasattr(tracing, "delivery_stage_stats")
+                    else {}
                 ),
             }
         )

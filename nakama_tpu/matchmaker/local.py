@@ -255,6 +255,14 @@ class LocalMatchmaker:
         self._paused = False
         self._stopped = False
         self._task: asyncio.Task | None = None
+        # Event-driven delivery stage (start() spawns it alongside the
+        # interval task): cohort worker threads set this event via
+        # call_soon_threadsafe the moment assembly finishes, and the
+        # delivery task runs accept → finalize → publish immediately —
+        # no gap poll between a cohort being ready and players seeing
+        # the match.
+        self._delivery_task: asyncio.Task | None = None
+        self._delivery_wakeup: asyncio.Event | None = None
 
     # ------------------------------------------------------ compat views
 
@@ -279,6 +287,14 @@ class LocalMatchmaker:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if self._delivery_task is not None:
+            self._delivery_task.cancel()
+            self._delivery_task = None
+        set_cb = getattr(self.backend, "set_ready_callback", None)
+        if set_cb is not None:
+            # Unhook the wakeup before the loop closes: a cohort
+            # finishing during shutdown must not signal a dead loop.
+            set_cb(None)
         wait_idle = getattr(self.backend, "wait_idle", None)
         if wait_idle is not None:
             # No device fetch thread may outlive the server (XLA aborts if
@@ -287,7 +303,12 @@ class LocalMatchmaker:
 
     def start(self):
         """Spawn the per-interval processing task (reference
-        matchmaker.go:250-260)."""
+        matchmaker.go:250-260) AND the event-driven delivery stage: the
+        interval task owns dispatch + gap maintenance; the delivery
+        task wakes on cohort-completion signals from the backend's
+        worker threads and ships accept → finalize → publish the
+        moment a cohort is ready (deadline-guard and watchdog timed
+        fallbacks cover lost signals and wedged heads)."""
 
         async def _loop():
             import gc
@@ -383,74 +404,142 @@ class LocalMatchmaker:
                             flush()
                     except Exception as e:
                         self.logger.error("gap flush error", error=str(e))
-                # Mid-gap delivery: ready cohorts ship NOW rather than
-                # at the next process() — at production cadence this
-                # takes a full interval_sec off add→matched. Poll at
-                # ~1s granularity (VERDICT r4 #3: a cohort becoming
-                # ready just after a sparse collection point used to
-                # wait for the next interval), waking EARLY for a cohort
-                # approaching its delivery deadline; at guard time the
-                # cohort's assembly is block-joined off the event loop
-                # so it ships before its own interval ends instead of
-                # slipping behind the poll schedule.
-                guard = max(
-                    0.1, self.config.pipeline_deadline_guard_sec
+                # Delivery is NOT this loop's job: the dedicated
+                # delivery stage (spawned alongside, below) wakes on the
+                # cohort-completion event the worker thread fires and
+                # runs accept → finalize → publish the moment a cohort
+                # is ready — the interval loop keeps only dispatch and
+                # maintenance, so a cohort ready 80ms after dispatch no
+                # longer waits out a gap poll schedule.
+                # Same small epsilon the pre-event gap poll ended on:
+                # process() fires just BEFORE the nominal boundary, so
+                # callers pacing adds on whole intervals enqueue for the
+                # NEXT dispatch instead of racing this one.
+                await asyncio.sleep(
+                    max(0.0, interval_end - 0.02 - time.perf_counter())
                 )
-                while not self._stopped and not self._paused:
-                    now = time.perf_counter()
-                    if now >= interval_end - 0.05:
-                        break
-                    wake = min(interval_end - 0.02, now + 1.0)
-                    deadline = self._next_cohort_deadline()
-                    if deadline is not None:
-                        # Floor at now+50ms: an overdue-but-unfinished
-                        # head must not collapse this into a zero-sleep
-                        # busy-spin that steals the GIL from the very
-                        # assembly thread it is waiting on.
-                        wake = min(
-                            wake, max(now + 0.05, deadline - guard)
-                        )
-                    await asyncio.sleep(
-                        max(0.0, wake - time.perf_counter())
-                    )
-                    if self._stopped or self._paused:
-                        break
-                    try:
-                        deadline = self._next_cohort_deadline()
-                        if (
-                            deadline is not None
-                            and time.perf_counter() >= deadline - guard
-                        ):
-                            join = getattr(
-                                self.backend, "join_head", None
-                            )
-                            if join is not None:
-                                # Bounded join in a worker thread: the
-                                # event loop stays responsive while the
-                                # cohort's assembly gets the core. Once
-                                # the head is overdue the bound looks
-                                # FORWARD (>=250ms) so each pass blocks
-                                # in the join instead of degenerating
-                                # into a join(0) spin.
-                                await asyncio.to_thread(
-                                    join,
-                                    max(
-                                        deadline + guard,
-                                        time.perf_counter() + 0.25,
-                                    ),
-                                )
-                        self.collect_pipelined()
-                    except Exception as e:
-                        self.logger.error(
-                            "mid-gap collection error", error=str(e)
-                        )
+                if self._stopped:
+                    break
                 if not self._paused:
                     try:
                         self.process()
                     except Exception as e:  # never kill the interval loop
                         self.logger.error("matchmaker process error", error=str(e))
 
-        self._task = asyncio.get_running_loop().create_task(_loop())
+        async def _delivery_loop():
+            # The delivery stage: waits for a cohort-completion wakeup
+            # (worker thread → call_soon_threadsafe), with two timed
+            # fallbacks — the head cohort's deadline-guard point (ship a
+            # near-deadline cohort via a bounded join even if its signal
+            # was lost) and a slow watchdog poll (belt-and-braces drain
+            # for lost wakeups / signal-less backends, NOT the delivery
+            # latency). Runs on the event loop, so accept/finalize/
+            # publish serialize with process() — the in-flight mask and
+            # sel-scratch invariants need no new locking.
+            guard = max(
+                0.1, float(self.config.pipeline_deadline_guard_sec)
+            )
+            watchdog = max(
+                0.05,
+                float(getattr(self.config, "delivery_watchdog_sec", 1.0)),
+            )
+            wakeup = self._delivery_wakeup
+            guard_joined = None  # head token already guard-joined once
+            while not self._stopped:
+                deadline = self._next_cohort_deadline()
+                now = time.perf_counter()
+                if deadline is None or deadline - guard <= now:
+                    # Nothing due (or the head is already at/past its
+                    # guard point and was handled below): event or
+                    # watchdog.
+                    timeout = watchdog
+                else:
+                    timeout = min(watchdog, deadline - guard - now)
+                cause = "watchdog"
+                try:
+                    await asyncio.wait_for(wakeup.wait(), timeout)
+                    cause = "event"
+                except asyncio.TimeoutError:
+                    if (
+                        deadline is not None
+                        and time.perf_counter() >= deadline - guard
+                    ):
+                        cause = "deadline"
+                wakeup.clear()
+                if self._stopped:
+                    break
+                if self._paused:
+                    continue
+                try:
+                    deadline = self._next_cohort_deadline()
+                    now = time.perf_counter()
+                    if deadline is not None and now >= deadline - guard:
+                        token = getattr(
+                            self.backend, "head_token", lambda: None
+                        )()
+                        ready = getattr(
+                            self.backend, "head_ready", lambda: True
+                        )()
+                        join = getattr(self.backend, "join_head", None)
+                        if (
+                            join is not None
+                            and not ready
+                            and token is not None
+                            and token != guard_joined
+                        ):
+                            # Bounded join in a worker thread (the event
+                            # loop stays responsive; the cohort's
+                            # assembly gets the core) — ONCE per head:
+                            # join_head itself refuses to block past the
+                            # head's own interval, and a head that
+                            # failed its one guard join is wedged —
+                            # booked to the reclaim path below, never
+                            # re-joined into the next cycle.
+                            guard_joined = token
+                            await asyncio.to_thread(
+                                join,
+                                max(
+                                    deadline + guard,
+                                    time.perf_counter() + 0.25,
+                                ),
+                            )
+                        if time.perf_counter() > deadline:
+                            reclaim = getattr(
+                                self.backend, "reclaim_stale", None
+                            )
+                            if reclaim is not None:
+                                reclaim()
+                    if self.metrics is not None:
+                        self.metrics.mm_delivery_wakeups.labels(
+                            cause=cause
+                        ).inc()
+                    self.collect_pipelined()
+                except Exception as e:
+                    self.logger.error(
+                        "delivery stage error", error=str(e)
+                    )
+
+        loop = asyncio.get_running_loop()
+        self._delivery_wakeup = asyncio.Event()
+        set_cb = getattr(self.backend, "set_ready_callback", None)
+        if set_cb is not None and getattr(
+            self.config, "delivery_event_driven", True
+        ):
+            wakeup = self._delivery_wakeup
+
+            def _signal():
+                # Worker thread → event loop: the only thread-safe way
+                # to poke an asyncio.Event. A loop already closed
+                # (shutdown race) just drops the signal — stop()'s
+                # wait_idle covers the tail.
+                try:
+                    loop.call_soon_threadsafe(wakeup.set)
+                except RuntimeError:
+                    pass
+
+            set_cb(_signal)
+        self._task = loop.create_task(_loop())
+        self._delivery_task = loop.create_task(_delivery_loop())
 
     # ------------------------------------------------------------------ add
 
@@ -567,6 +656,8 @@ class LocalMatchmaker:
         collect = getattr(self.backend, "collect_ready", None)
         if collect is None:
             return None
+        tracing = getattr(self.backend, "tracing", None)
+        n_ledger = getattr(tracing, "deliveries_total", 0)
         try:
             out = collect(
                 rev_precision=self.config.rev_precision,
@@ -594,7 +685,29 @@ class LocalMatchmaker:
             self._update_gauges()
         if len(batch) and self.on_matched is not None:
             self._publish(batch)
+            self._stamp_published(tracing, n_ledger)
         return batch
+
+    def _stamp_published(self, tracing, n_before: int):
+        """Close the per-cohort stage chain: stamp dispatch→published
+        lag on the ledger entries this collect/process call recorded
+        (the cohorts whose matches were just handed to `on_matched`).
+        Feeds the matchmaker_delivery_publish_lag histogram — the
+        end-to-end number the dispatched→ready→accepted→published
+        attribution hangs off."""
+        if tracing is None:
+            return
+        mark = getattr(tracing, "mark_published", None)
+        if mark is None:
+            return
+        # Monotonic-counter delta, NOT a deque-length delta: once the
+        # bounded ledger fills, its length stops moving and a length
+        # delta would stamp nothing forever.
+        n_new = max(0, tracing.deliveries_total - n_before)
+        lags = mark(time.perf_counter(), max_n=n_new)
+        if self.metrics is not None:
+            for lag in lags:
+                self.metrics.mm_delivery_publish_lag.observe(lag)
 
     def _publish(self, batch: MatchBatch):
         """Deliver a matched batch to `on_matched`, bounded by the fault
@@ -632,6 +745,8 @@ class LocalMatchmaker:
         host-only object paths."""
         t0 = time.perf_counter()
         t_backend = t0  # re-stamped just before the backend call below
+        _tracing = getattr(self.backend, "tracing", None)
+        _n_ledger = getattr(_tracing, "deliveries_total", 0)
         store = self.store
         meta = store.meta
         active_slots = store.active_slots()
@@ -703,6 +818,7 @@ class LocalMatchmaker:
 
         if len(batch) and self.on_matched is not None:
             self._publish(batch)
+            self._stamp_published(_tracing, _n_ledger)
         # Attribute the post-backend tail (slot removal, delivery
         # callback) on the interval's breadcrumb: the p99 work that
         # isn't inside process_slots must still be visible to the bench

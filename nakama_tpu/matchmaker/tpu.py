@@ -81,8 +81,13 @@ def _pow2_blocks(blocks: int) -> int:
 
 def _work_ready(work: tuple) -> bool:
     """Has this dispatched work's device compute + D2H + gap-side
-    assembly completed?"""
-    return not work[0][-1].is_alive()
+    assembly completed? The ready stamp (written before the completion
+    signal fires) is authoritative: a collector woken BY the signal
+    must see a ready head even though the worker thread is still
+    unwinding its last microseconds; thread liveness is only the
+    fallback for paths with no stamp."""
+    holder = work[0][1]
+    return "t_ready" in holder or not work[0][-1].is_alive()
 
 
 def _work_deadline(work: tuple) -> float | None:
@@ -281,6 +286,17 @@ class TpuBackend:
         )
         self.inflight_reclaimed = 0  # ledger total (tests/console)
         self._sweep_tick = 0  # gates the O(capacity) orphan scan
+        # Cohort-completion signal (event-driven delivery): called from
+        # the cohort's worker thread the moment its device pass + gap
+        # assembly finish (success OR failure), so the delivery stage
+        # wakes immediately instead of a gap poll discovering the result
+        # seconds later. None = nobody listening (tests, sync mode).
+        self._ready_cb = None
+        # Monotonic per-dispatch sequence: head_token identity. id() of
+        # the holder dict is NOT usable — CPython reuses a freed
+        # holder's address for the next cohort's, which would make a
+        # new head look already-guard-joined.
+        self._dispatch_counter = 0
 
     def attach(self, store):
         """Bind the LocalMatchmaker's SlotStore: one slot space shared by
@@ -842,6 +858,42 @@ class TpuBackend:
 
     # ----------------------------------------------- pipeline state surface
 
+    def set_ready_callback(self, cb):
+        """Register the cohort-completion signal: `cb()` is invoked FROM
+        THE COHORT'S WORKER THREAD whenever a dispatched cohort's device
+        pass + gap-side assembly finish (including on failure — a failed
+        cohort must also be collected promptly so its slots reclaim).
+        The callback must be cheap and thread-safe; the delivery stage
+        passes a `loop.call_soon_threadsafe` wakeup. None unregisters."""
+        self._ready_cb = cb
+
+    def head_ready(self) -> bool:
+        """Is the head cohort's device pass + assembly complete (its
+        collection would be free, no blocking join)?"""
+        return bool(self._pipeline_queue) and _work_ready(
+            self._pipeline_queue[0]
+        )
+
+    def head_token(self):
+        """Opaque identity of the current head cohort (None when the
+        queue is empty): its monotonic dispatch sequence number, never
+        reused. The delivery stage guard-joins each head at most once —
+        a token it already joined and found unfinished is a wedged
+        head, booked to the reclaim path instead of re-joined into the
+        next cycle."""
+        if not self._pipeline_queue:
+            return None
+        return self._pipeline_queue[0][0][1].get("dispatch_seq")
+
+    def reclaim_stale(self):
+        """Public reclamation entry for the delivery stage: abandon
+        cohorts wedged `inflight_reclaim_deadline_ms` past their
+        delivery deadline and clear orphaned in-flight claims BETWEEN
+        process() calls. Without this the backstop sweep only runs once
+        per interval, so a wedged head discovered mid-gap would hold
+        the queue until the next dispatch."""
+        self._reclaim_stale()
+
     def next_deadline(self) -> float | None:
         """Earliest delivery deadline among queued cohorts (perf_counter
         seconds), or None when nothing is in flight. The interval loop
@@ -882,12 +934,31 @@ class TpuBackend:
         cohort's worker thread) until the head cohort's assembly
         finishes or `until` (perf_counter seconds) passes. Returns
         readiness. The deadline guard's last resort: on a contended host
-        the join IS the preemption that lets the cohort finish."""
-        if not self._pipeline_queue:
-            return False
+        the join IS the preemption that lets the cohort finish.
+
+        Bounded twice: by the caller's `until`, and — wedged-head
+        protection — by the head's OWN interval: the join never blocks
+        past `deadline + guard`, so a wedged fetch/assembly thread can
+        at worst cost the guard one bounded join, never hold it into
+        the next cycle. A head still unfinished past that point belongs
+        to the reclaim path (`inflight_reclaim_deadline_ms` →
+        reclaim_stale abandons it and frees its slots)."""
         import time as _time
 
-        head = self._pipeline_queue[0]
+        try:
+            # Runs in a worker thread (delivery stage's asyncio.to_thread)
+            # while the event loop may pop the queue from process_slots:
+            # the head can vanish between an emptiness check and the
+            # subscript, so take it under IndexError instead.
+            head = self._pipeline_queue[0]
+        except IndexError:
+            return False
+        dl = _work_deadline(head)
+        if dl is not None:
+            guard = max(
+                0.1, float(self.config.pipeline_deadline_guard_sec)
+            )
+            until = min(until, dl + guard)
         head[0][-1].join(max(0.0, until - _time.perf_counter()))
         return _work_ready(head)
 
@@ -981,6 +1052,7 @@ class TpuBackend:
             self.breaker.record_success()
         holder = w_pending[1]
         t_disp = holder.get("t_dispatch")
+        ledger = None  # written AFTER the accept span (accept_lag_s)
         if t_disp is not None:
             # Cohort delivery attribution (VERDICT r4 #3), measured
             # AFTER the join above so a not-yet-ready cohort popped by
@@ -1013,12 +1085,17 @@ class TpuBackend:
             # blocking same-interval collects would otherwise pollute
             # the delivery-lag histogram and evict real pipelined
             # entries from the ledger window slip_count() reads.
+            # Recorded after the accept span below so the entry carries
+            # the full per-stage chain (dispatched→ready→fetched→
+            # collected→accepted; local.py stamps →published).
             if pipelined:
-                self.tracing.record_delivery(
+                ledger = dict(
                     ready_lag_s=round(ready_lag, 3),
                     fetch_lag_s=round(fetch_lag, 3),
                     collect_lag_s=round(collect_lag, 3),
                     slipped=bool(slipped),
+                    dispatched_ts=holder.get("t_dispatch_wall"),
+                    _pc_dispatch=t_disp,
                 )
                 if self.metrics is not None:
                     self.metrics.mm_delivery_lag.observe(collect_lag)
@@ -1092,6 +1169,13 @@ class TpuBackend:
             sel[good_flat] = True
             flat_parts.append(good_flat)
             size_parts.append(sizes[good])
+        if ledger is not None:
+            import time as _time
+
+            ledger["accept_lag_s"] = round(
+                _time.perf_counter() - t_disp, 3
+            )
+            self.tracing.record_delivery(**ledger)
 
     def _finalize_batch(self, sel, flat_parts, size_parts, react_parts):
         if flat_parts:
@@ -1377,8 +1461,14 @@ class TpuBackend:
         import time as _time
 
         t_disp = _time.perf_counter()
+        self._dispatch_counter += 1
         holder: dict = {
+            "dispatch_seq": self._dispatch_counter,
             "t_dispatch": t_disp,
+            # Wall-clock twin of t_dispatch: ledger consumers (bench
+            # slip gate, profile spans) attribute cohorts to dispatch
+            # windows without reconstructing it from lag arithmetic.
+            "t_dispatch_wall": _time.time(),
             # Delivery deadline: the cohort must reach players before its
             # OWN interval ends. collect_ready preempts gap work for a
             # cohort nearing this stamp (local.py deadline guard).
@@ -1426,6 +1516,16 @@ class TpuBackend:
                 out["err"] = e
             finally:
                 out["t_ready"] = _time.perf_counter()
+                # Completion signal LAST (after the ready stamp, so a
+                # woken collector always sees a finished cohort). A
+                # failing callback must never kill the worker before
+                # its results are parked.
+                cb = self._ready_cb
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:
+                        pass
 
         thread = threading.Thread(target=_run, daemon=True)
         thread.start()

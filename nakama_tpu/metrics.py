@@ -88,6 +88,25 @@ class Metrics:
             "matchmaker_cohort_slipped",
             "Cohorts delivered past their own interval deadline",
         )
+        # Event-driven delivery stage: dispatch→published per-cohort
+        # latency (the full stage chain, one step past the collect lag
+        # above) and the stage's wakeup causes — a healthy deployment
+        # is dominated by "event"; a rising "watchdog"/"deadline" share
+        # means completion signals are being lost or heads are wedging.
+        self.mm_delivery_publish_lag = Histogram(
+            "matchmaker_delivery_publish_lag_sec",
+            "Pipelined cohort dispatch→published lag (full stage chain)",
+            (),
+            namespace=ns,
+            registry=self.registry,
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0),
+        )
+        self.mm_delivery_wakeups = counter(
+            "matchmaker_delivery_wakeups",
+            "Delivery-stage wakeups by cause (event = cohort-completion "
+            "signal, deadline = guard point, watchdog = fallback poll)",
+            ("cause",),
+        )
         self.mm_gap_shed = counter(
             "matchmaker_gap_work_shed",
             "Interval gaps whose GC/drain/flush were shed under pipeline "

@@ -297,6 +297,23 @@ class NakamaServer:
         self.google_refund_scheduler.start()
         self.tracker.start()
         self.matchmaker.start()
+        mm_cfg = self.config.matchmaker
+        if mm_cfg.interval_pipelining:
+            # The delivery posture in one line: operators diagnosing a
+            # dispatch→matched tail need to know whether cohorts ship on
+            # completion events or on the watchdog poll cadence.
+            self.logger.info(
+                "matchmaker delivery stage started",
+                event_driven=bool(
+                    getattr(mm_cfg, "delivery_event_driven", True)
+                ),
+                watchdog_sec=float(
+                    getattr(mm_cfg, "delivery_watchdog_sec", 1.0)
+                ),
+                deadline_guard_sec=float(
+                    mm_cfg.pipeline_deadline_guard_sec
+                ),
+            )
         # One port serves the REST API and /ws (reference api.go: the
         # gateway HTTP listener owns both on the main port).
         self.port = await self.api.start(

@@ -27,8 +27,12 @@ class Tracing:
         self.breadcrumbs: deque[dict] = deque(maxlen=capacity)
         # Per-cohort pipelined delivery ledger (dispatch→delivered lag,
         # deadline slips): slips are observable here and via metrics,
-        # not inferred from bench WARN lines.
+        # not inferred from bench WARN lines. deliveries_total counts
+        # every record ever made — length deltas on the bounded deque
+        # go to zero once it fills, so "how many did this call add"
+        # questions (publish stamping) must use the monotonic counter.
         self.deliveries: deque[dict] = deque(maxlen=capacity)
+        self.deliveries_total = 0
         # Group-commit drain spans from the storage write batcher
         # (record_db_drain): batch size / drain time / queue depth.
         self.db_drains: deque[dict] = deque(maxlen=capacity)
@@ -91,9 +95,62 @@ class Tracing:
         mid-gap deliveries don't dilute per-interval timing rows."""
         fields.setdefault("ts", time.time())
         self.deliveries.append(fields)
+        self.deliveries_total += 1
 
     def recent_deliveries(self, n: int = 32) -> list[dict]:
         return list(self.deliveries)[-n:]
+
+    def mark_published(
+        self, pc_now: float, max_n: int | None = None
+    ) -> list[float]:
+        """Stamp dispatch→published lag on the newest ledger entries
+        that have none yet (the cohorts whose batch the caller just
+        handed to `on_matched`), closing each entry's stage chain:
+        ready_lag_s → fetch_lag_s → collect_lag_s → accept_lag_s →
+        publish_lag_s, all relative to dispatch. `max_n` bounds the
+        stamping to the entries one collect call recorded, so a cohort
+        that never published (empty batch, no callback) cannot absorb a
+        much-later publish stamp. Returns the lags stamped."""
+        out: list[float] = []
+        for entry in reversed(self.deliveries):
+            if "publish_lag_s" in entry:
+                break
+            if max_n is not None and len(out) >= max_n:
+                break
+            t_disp = entry.get("_pc_dispatch")
+            if t_disp is None:
+                continue
+            lag = pc_now - t_disp
+            entry["publish_lag_s"] = round(lag, 3)
+            out.append(lag)
+        return out
+
+    def delivery_stage_stats(self) -> dict:
+        """p50/p99 per delivery stage over the retained ledger — the
+        one-call attribution surface (profile_interval.py, console): a
+        delivery-gap regression names its stage here instead of hiding
+        inside a single end-to-end number."""
+        stages = (  # chain order: D2H fetch, then assembly completes
+            "fetch_lag_s",
+            "ready_lag_s",
+            "collect_lag_s",
+            "accept_lag_s",
+            "publish_lag_s",
+        )
+        out: dict[str, dict] = {}
+        for key in stages:
+            vals = sorted(
+                d[key]
+                for d in self.deliveries
+                if isinstance(d.get(key), (int, float))
+            )
+            if vals:
+                out[key] = {
+                    "p50": vals[len(vals) // 2],
+                    "p99": vals[min(len(vals) - 1, int(len(vals) * 0.99))],
+                    "n": len(vals),
+                }
+        return out
 
     def slip_count(self) -> int:
         """Deliveries in the retained window that missed their cohort's

@@ -1,0 +1,381 @@
+"""Event-driven cohort delivery (the dispatch→matched tail killer).
+
+The delivery stage (matchmaker/local.py `_delivery_loop`) wakes on the
+cohort worker thread's completion signal and runs accept → finalize →
+publish immediately; the interval loop keeps only dispatch and
+maintenance. These tests pin the contract:
+
+- a ready cohort is delivered within a small bound of its completion
+  signal — no poll quantization (the latency-ratio assertion runs in a
+  SUBPROCESS, matching the tier-1 perf-test convention: in-suite heap
+  and scheduling noise would flake a wall-clock bound);
+- delivery racing a concurrent dispatch preserves cohort order and the
+  PR 3 in-flight mask invariants;
+- the PR 3 chaos points (`device.collect`, `delivery.publish`) still
+  reclaim cleanly on the new path;
+- `join_head` is bounded by the head cohort's own interval and a wedged
+  head is booked to the reclaim path (`inflight_reclaim_deadline_ms`),
+  never re-joined into the next cycle;
+- the bench cadence slip gate (`cadence_regression`) flags any slipped
+  cycle or ledger-slipped cohort as a regression.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+from nakama_tpu import faults
+from nakama_tpu.config import MatchmakerConfig
+from nakama_tpu.logger import test_logger as quiet_logger
+from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+from nakama_tpu.matchmaker.tpu import TpuBackend
+from nakama_tpu.metrics import Metrics
+
+_uid = 0
+
+
+def _presence():
+    global _uid
+    _uid += 1
+    return MatchmakerPresence(
+        user_id=f"ev-u{_uid}", session_id=f"ev-s{_uid}"
+    )
+
+
+def _add_pair(mm, mode):
+    for _ in range(2):
+        p = _presence()
+        mm.add(
+            [p], p.session_id, "", f"properties.mode:{mode}", 2, 2, 1,
+            {"mode": mode}, {},
+        )
+
+
+def _mk(**kw):
+    defaults = dict(
+        pool_capacity=256,
+        candidates_per_ticket=64,
+        numeric_fields=8,
+        string_fields=8,
+        max_constraints=8,
+        max_intervals=99,
+    )
+    defaults.update(kw)
+    cfg = MatchmakerConfig(**defaults)
+    got = []
+    metrics = Metrics(namespace="ev")  # private registry per instance
+    backend = TpuBackend(
+        cfg, quiet_logger(), metrics, row_block=8, col_block=64
+    )
+    mm = LocalMatchmaker(
+        quiet_logger(), cfg, metrics=metrics, backend=backend,
+        on_matched=got.append,
+    )
+    return mm, got, backend, metrics
+
+
+# --------------------------------------------------- completion signal
+
+
+def test_worker_thread_fires_ready_callback():
+    """The cohort worker signals completion exactly once per cohort,
+    from its own thread, after the ready stamp — so a woken collector
+    always finds a collectable head."""
+    mm, got, backend, _ = _mk()
+    import threading
+
+    evt = threading.Event()
+    backend.set_ready_callback(evt.set)
+    _add_pair(mm, "sig")
+    mm.process()  # dispatch
+    assert evt.wait(30), "completion signal never fired"
+    assert backend.head_ready()
+    assert mm.collect_pipelined() is not None
+    assert len(got) == 1 and len(got[0][0]) == 2
+    # Ledger carries the full per-stage chain including the new
+    # accept/publish stages.
+    (d,) = backend.tracing.recent_deliveries(1)
+    for key in (
+        "ready_lag_s", "collect_lag_s", "accept_lag_s", "publish_lag_s",
+    ):
+        assert isinstance(d.get(key), float), (key, d)
+    assert d["ready_lag_s"] <= d["collect_lag_s"] <= d["accept_lag_s"]
+    assert d["accept_lag_s"] <= d["publish_lag_s"]
+
+
+# ------------------------------------------------ ordering + invariants
+
+
+async def _drive_racing(mm, cycles, interval):
+    mm.start()
+    try:
+        for cycle in range(cycles):
+            # Mid-interval adds: deliveries of earlier cohorts race
+            # these dispatches on the same loop.
+            await asyncio.sleep(interval / 2)
+            _add_pair(mm, f"r{cycle}")
+            await asyncio.sleep(interval / 2)
+        await asyncio.sleep(interval + 0.5)
+    finally:
+        mm.stop()
+
+
+def test_delivery_racing_dispatch_preserves_order_and_masks():
+    """Cohorts deliver in dispatch order while new dispatches land
+    between them; no ticket is delivered twice; once the pipeline
+    drains, no in-flight claim survives and every live ticket is
+    matchable (the PR 3 invariants on the event-driven path)."""
+    interval = 1
+    mm, got, backend, _ = _mk(
+        interval_sec=interval, pipeline_deadline_guard_sec=0.3
+    )
+    asyncio.run(_drive_racing(mm, cycles=3, interval=interval))
+    deliveries = backend.tracing.recent_deliveries(100)
+    assert len(deliveries) >= 3, deliveries
+    # Cohort ordering: ledger entries are recorded in collection order,
+    # which must be dispatch order (the queue pops heads only).
+    pcs = [d["_pc_dispatch"] for d in deliveries]
+    assert pcs == sorted(pcs), deliveries
+    # No ticket matched twice across all published batches.
+    seen = set()
+    for batch in got:
+        for entry_set in batch:
+            for e in entry_set:
+                assert e.ticket not in seen, e.ticket
+                seen.add(e.ticket)
+    assert len(seen) == 6, seen  # 3 cohorts x 2 tickets all delivered
+    # Mask invariants after drain: no in-flight bits without a queued
+    # cohort, no alive-but-unmatchable slots.
+    assert backend.pipeline_depth() == 0
+    assert int(backend._in_flight_mask.sum()) == 0
+    store = mm.store
+    assert int(store.alive.sum()) == int(store.active.sum())
+
+
+# ----------------------------------------------------- chaos points
+
+
+def test_chaos_collect_raise_reclaims_on_event_path():
+    """An armed device.collect failure surfaces through the delivery
+    stage (not a gap poll): the cohort's slots reclaim, the tickets
+    retry on a later dispatch, and the match still lands."""
+    interval = 1
+    mm, got, backend, _ = _mk(
+        interval_sec=interval, pipeline_deadline_guard_sec=0.3
+    )
+    faults.arm("device.collect", "raise", count=1)
+
+    async def drive():
+        mm.start()
+        try:
+            _add_pair(mm, "cc")
+            # Interval 1 dispatches; the worker raises; the delivery
+            # stage collects the failure and reclaims; interval 2+
+            # re-dispatches the reactivated pair.
+            await asyncio.sleep(4 * interval)
+        finally:
+            mm.stop()
+
+    try:
+        asyncio.run(drive())
+    finally:
+        faults.disarm()
+    assert backend.inflight_reclaimed >= 2  # the failed cohort's pair
+    total = sum(len(es) for b in got for es in b)
+    assert total == 2, got  # retried and delivered
+    assert int(backend._in_flight_mask.sum()) == 0
+
+
+def test_chaos_publish_drop_on_event_path():
+    """delivery.publish drop-mode on the event-driven path: the publish
+    is discarded and counted, interval bookkeeping survives (single-
+    shot semantics: the matched tickets left the pool), and the next
+    cohort publishes normally."""
+    interval = 1
+    mm, got, backend, metrics = _mk(
+        interval_sec=interval, pipeline_deadline_guard_sec=0.3
+    )
+    faults.arm("delivery.publish", "drop", count=1)
+
+    async def drive():
+        mm.start()
+        try:
+            _add_pair(mm, "pd")
+            await asyncio.sleep(2 * interval + 0.5)  # dropped publish
+            _add_pair(mm, "pd2")
+            await asyncio.sleep(2 * interval + 0.5)  # healthy publish
+        finally:
+            mm.stop()
+
+    try:
+        asyncio.run(drive())
+    finally:
+        faults.disarm()
+    dropped = metrics.snapshot().get(
+        "ev_matchmaker_delivery_failed_total", 0.0
+    )
+    assert dropped == 1.0, metrics.snapshot()
+    total = sum(len(es) for b in got for es in b)
+    assert total == 2, got  # only the post-drop cohort reached players
+    assert len(mm.store) == 0  # single-shot: both cohorts left the pool
+    assert int(backend._in_flight_mask.sum()) == 0
+
+
+# ------------------------------------------------- bounded join_head
+
+
+def test_join_head_bounded_by_own_interval_and_booked_to_reclaim():
+    """A wedged head cohort can never block the deadline guard past its
+    own interval: join_head returns at deadline+guard no matter how
+    generous the caller's bound, and the reclaim path
+    (inflight_reclaim_deadline_ms) abandons the cohort — slots freed,
+    tickets reactivated — instead of the guard re-joining it forever."""
+    mm, got, backend, _ = _mk(
+        interval_sec=1,
+        pipeline_deadline_guard_sec=0.3,
+        inflight_reclaim_deadline_ms=500,
+    )
+    orig = backend._assemble
+
+    def wedged(*a, **kw):
+        time.sleep(3.0)
+        return orig(*a, **kw)
+
+    backend._assemble = wedged
+    _add_pair(mm, "wd")
+    t_disp = time.perf_counter()
+    mm.process()  # dispatch; worker wedged 3s
+    joined = backend.join_head(time.perf_counter() + 60.0)
+    waited = time.perf_counter() - t_disp
+    assert not joined
+    # deadline = dispatch + max(1, interval_sec) = +1s; guard 0.3 →
+    # the join must give up by ~1.3s even with a 60s caller bound.
+    assert waited < 2.0, waited
+    # Book to reclaim: deadline + 500ms grace → abandoned well before
+    # the worker's 3s wedge resolves.
+    deadline = time.perf_counter() + 3.0
+    while backend.pipeline_depth() and time.perf_counter() < deadline:
+        backend.reclaim_stale()
+        time.sleep(0.05)
+    assert backend.pipeline_depth() == 0
+    assert int(backend._in_flight_mask.sum()) == 0
+    assert backend.inflight_reclaimed >= 2
+    # Reactivated: matchable again next interval.
+    assert int(mm.store.active.sum()) == 2
+    mm.stop()  # joins the wedged worker so it can't outlive the test
+
+
+# ------------------------------------------------------- slip gate
+
+
+def test_cadence_slip_gate_flags_regressions():
+    """bench.cadence_regression: ANY slipped cycle or ledger-slipped
+    cohort → regression (rc 1). The BENCH_r05 failure mode — slips in
+    the metric, rc 0 — must be structurally impossible."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import cadence_regression
+
+    clean = [{"cycle": 1, "max_ms": 900.0}, {"cycle": 2, "max_ms": 400.0}]
+    assert cadence_regression(clean, 0, 15) == (0, False)
+    # One 34s cycle at a 15s cadence: slipped AND regression.
+    bad = clean + [{"cycle": 3, "max_ms": 34003.1}]
+    assert cadence_regression(bad, 0, 15) == (1, True)
+    # Ledger-stamped cohort slip with clean per-cycle maxima (the
+    # force-drain case): still a regression.
+    assert cadence_regression(clean, 1, 15) == (0, True)
+    # Cycles with no samples (max_ms None) don't crash or flag.
+    assert cadence_regression(
+        [{"cycle": 1, "max_ms": None}], 0, 15
+    ) == (0, False)
+
+
+# ---------------------------------------- no poll quantization (child)
+
+_CHILD = """
+import asyncio, json, time
+from nakama_tpu.config import MatchmakerConfig
+from nakama_tpu.logger import test_logger
+from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+from nakama_tpu.matchmaker.tpu import TpuBackend
+
+cfg = MatchmakerConfig(
+    pool_capacity=256, candidates_per_ticket=64, numeric_fields=8,
+    string_fields=8, max_constraints=8, max_intervals=99,
+    interval_sec=2, pipeline_deadline_guard_sec=0.5,
+    delivery_watchdog_sec=30.0,  # a poll could NOT deliver in-bound
+)
+backend = TpuBackend(cfg, test_logger(), row_block=8, col_block=64)
+got = []
+mm = LocalMatchmaker(
+    test_logger(), cfg, backend=backend, on_matched=got.append
+)
+uid = [0]
+
+def add_pair(mode):
+    for _ in range(2):
+        uid[0] += 1
+        p = MatchmakerPresence(
+            user_id=f"q-u{uid[0]}", session_id=f"q-s{uid[0]}"
+        )
+        mm.add([p], p.session_id, "", f"properties.mode:{mode}", 2, 2,
+               1, {"mode": mode}, {})
+
+async def drive():
+    mm.start()
+    try:
+        for cycle in range(3):
+            add_pair(f"m{cycle}")
+            await asyncio.sleep(cfg.interval_sec)
+        await asyncio.sleep(cfg.interval_sec + 0.5)
+    finally:
+        mm.stop()
+
+asyncio.run(drive())
+out = [
+    {
+        "ready": d["ready_lag_s"],
+        "collected": d["collect_lag_s"],
+        "published": d.get("publish_lag_s"),
+    }
+    for d in backend.tracing.recent_deliveries(100)
+]
+print(json.dumps({"deliveries": out,
+                  "entries": sum(len(es) for b in got for es in b)}))
+"""
+
+
+def test_event_delivery_within_bound_no_poll_quantization():
+    """Subprocess-isolated (tier-1 perf-test convention): through the
+    REAL loop with the watchdog at 30s, every cohort must still be
+    collected within a small bound of its completion signal. A
+    poll-quantized delivery (the pre-event behavior: ~1s polls, or
+    worse the next interval) cannot pass — with the watchdog pushed to
+    30s, only the event wakeup or the 1.5s-away deadline guard can
+    deliver, and the bound is far below the guard point."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout.splitlines()[-1])
+    deliveries = out["deliveries"]
+    assert len(deliveries) >= 3, out
+    assert out["entries"] == 6, out
+    for d in deliveries:
+        gap = d["collected"] - d["ready"]
+        # ready→collected must ride the completion signal: the deadline
+        # guard sits 1.5s after dispatch and the watchdog 30s away, so
+        # anything but the event wakeup blows this bound.
+        assert gap < 1.0, deliveries
+        assert d["published"] is not None and d["published"] < 2.0, (
+            deliveries
+        )
