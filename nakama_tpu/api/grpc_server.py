@@ -308,8 +308,22 @@ class GrpcGateway:
         async def handler(request, context):
             meta = dict(context.invocation_metadata() or ())
             auth = meta.get("authorization", "")
+            # Deadline propagation: the client's deadline rides the
+            # loopback call as a grpc-timeout header; the REST overload
+            # middleware parses it, enforces it, and carries it into
+            # storage / matchmaker checkpoints — so gRPC callers get
+            # DEADLINE_EXCEEDED/RESOURCE_EXHAUSTED from the same single
+            # enforcement point REST callers do. The transport consumes
+            # the wire grpc-timeout before invocation_metadata(), so
+            # the REMAINING time comes from context.time_remaining()
+            # (None = no client deadline).
+            timeout = meta.get("grpc-timeout", "")
+            if not timeout:
+                remaining = context.time_remaining()
+                if remaining is not None:
+                    timeout = f"{max(1, int(remaining * 1000))}m"
             try:
-                return await self._call(spec, request, auth)
+                return await self._call(spec, request, auth, timeout)
             except _ApiStatusError as e:
                 await context.abort(e.code, e.message)
             except Exception as e:  # transcode/transport failure
@@ -324,7 +338,9 @@ class GrpcGateway:
             response_serializer=lambda m: m.SerializeToString(),
         )
 
-    async def _call(self, spec: RouteSpec, request, auth: str):
+    async def _call(
+        self, spec: RouteSpec, request, auth: str, timeout: str = ""
+    ):
         body = json_format.MessageToDict(
             request, preserving_proto_field_name=True
         )
@@ -355,6 +371,8 @@ class GrpcGateway:
         headers = {}
         if auth:
             headers["Authorization"] = auth
+        if timeout:
+            headers["grpc-timeout"] = timeout
         async with self._http.request(
             spec.verb,
             self._base + path,
@@ -381,6 +399,8 @@ class GrpcGateway:
                         400: grpc.StatusCode.INVALID_ARGUMENT,
                         404: grpc.StatusCode.NOT_FOUND,
                         405: grpc.StatusCode.INVALID_ARGUMENT,
+                        429: grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        504: grpc.StatusCode.DEADLINE_EXCEEDED,
                     }.get(resp.status, grpc.StatusCode.INTERNAL)
                     message = f"HTTP {resp.status}"
                 raise _ApiStatusError(code, message)

@@ -23,6 +23,7 @@ from typing import Any
 
 from aiohttp import WSMsgType, web
 
+from .. import overload
 from ..core import account as core_account
 from ..core import authenticate as core_auth
 from ..core import link as core_link
@@ -43,6 +44,8 @@ GRPC_PERMISSION_DENIED = 7
 GRPC_NOT_FOUND = 5
 GRPC_ALREADY_EXISTS = 6
 GRPC_INVALID_ARGUMENT = 3
+GRPC_DEADLINE_EXCEEDED = 4
+GRPC_RESOURCE_EXHAUSTED = 8
 GRPC_INTERNAL = 13
 GRPC_UNIMPLEMENTED = 12
 
@@ -61,10 +64,13 @@ class ApiError(Exception):
         self.grpc_code = grpc_code
 
 
-def _error_response(message: str, status: int, grpc_code: int):
+def _error_response(
+    message: str, status: int, grpc_code: int, headers: dict | None = None
+):
     return web.json_response(
         {"error": message, "message": message, "code": grpc_code},
         status=status,
+        headers=headers,
     )
 
 
@@ -103,6 +109,14 @@ class _WsAdapter:
                 return
 
 
+# Paths outside admission control: health/index must answer even under
+# SHED (that's how operators see the server is alive), and /ws is a
+# long-lived upgrade — holding a permit for a connection's lifetime
+# would exhaust the pool, so realtime admission is per-envelope in the
+# pipeline instead.
+_OVERLOAD_EXEMPT = frozenset({"/", "/healthcheck", "/v2/healthcheck", "/ws"})
+
+
 class ApiServer:
     """Routes + auth middleware over the NakamaServer's components."""
 
@@ -111,7 +125,8 @@ class ApiServer:
         self.config = server.config
         self.logger = server.logger.with_fields(subsystem="api")
         self.app = web.Application(
-            client_max_size=self.config.socket.max_request_size_bytes
+            client_max_size=self.config.socket.max_request_size_bytes,
+            middlewares=[self._overload_middleware],
         )
         self._runner: web.AppRunner | None = None
         self._site = None
@@ -227,6 +242,98 @@ class ApiServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+
+    # ------------------------------------------------------------ overload
+
+    def _rate_key(self, request: web.Request) -> str:
+        """Rate-limiter key: client IP + the tail of the presented
+        credential, so one NATed IP's users don't share a bucket but an
+        unauthenticated flood from one address still does."""
+        return f"{request.remote}|{request.headers.get('Authorization', '')[-16:]}"
+
+    @web.middleware
+    async def _overload_middleware(self, request: web.Request, handler):
+        """The overload triad at the front door (overload.py): deadline
+        from `grpc-timeout`/`X-Request-Timeout` (else the per-class
+        default), token-bucket rate limit, prioritized admission, and
+        the deadline carried via contextvar into storage/matchmaker
+        checkpoints. GET = list/read class; everything else =
+        authenticated-RPC class (realtime envelopes are classed in the
+        pipeline). The disarmed cost is one deadline object, one
+        contextvar set/reset, and the admission fast path."""
+        ov = getattr(self.server, "overload", None)
+        if ov is None or request.path in _OVERLOAD_EXEMPT:
+            return await handler(request)
+        # Class before auth runs (auth lives in the handlers), so the
+        # credential HEADER is the classifier: a request presenting no
+        # credential at all can only ever be rejected by auth — it gets
+        # the lowest class regardless of verb, so an anonymous POST
+        # flood can't occupy RPC-class permits that authenticated
+        # writes are competing for. (A forged Bearer still classes RPC
+        # until its 401 — the rate limiter is the per-key backstop.)
+        cls = (
+            overload.RPC
+            if request.method != "GET"
+            and (
+                request.headers.get("Authorization")
+                or request.query.get("http_key")
+            )
+            else overload.LIST
+        )
+        ocfg = self.config.overload
+        default_ms = (
+            ocfg.deadline_list_ms if cls == overload.LIST
+            else ocfg.deadline_rpc_ms
+        ) or ocfg.deadline_default_ms
+        try:
+            deadline = overload.deadline_from_headers(
+                request.headers, default_ms
+            )
+        except ValueError as e:
+            return _error_response(str(e), 400, GRPC_INVALID_ARGUMENT)
+        limiter = ov.rate_limiter
+        if limiter is not None and not limiter.allow(self._rate_key(request)):
+            e = ov.admission.reject(cls, "rate_limited")
+            return _error_response(
+                str(e), 429, GRPC_RESOURCE_EXHAUSTED,
+                headers={"Retry-After": str(int(e.retry_after_sec))},
+            )
+        try:
+            await ov.admission.admit(cls, deadline)
+        except overload.AdmissionRejected as e:
+            return _error_response(
+                str(e), 429, GRPC_RESOURCE_EXHAUSTED,
+                headers={"Retry-After": str(int(e.retry_after_sec))},
+            )
+        except overload.DeadlineExceeded as e:
+            self._note_deadline()
+            return _error_response(str(e), 504, GRPC_DEADLINE_EXCEEDED)
+        token = overload.set_deadline(deadline)
+        try:
+            if deadline.explicit:
+                # A client-supplied timeout is ENFORCED: the handler is
+                # cancelled at expiry and the caller gets their 504
+                # immediately instead of a slow success they abandoned.
+                # Config-default deadlines only propagate (queue-drop
+                # checkpoints) — no wait_for task per routine request.
+                try:
+                    return await asyncio.wait_for(
+                        handler(request), max(0.0, deadline.remaining())
+                    )
+                except asyncio.TimeoutError:
+                    self._note_deadline()
+                    return _error_response(
+                        "deadline exceeded", 504, GRPC_DEADLINE_EXCEEDED
+                    )
+            return await handler(request)
+        finally:
+            overload.reset_deadline(token)
+            ov.admission.release()
+
+    def _note_deadline(self):
+        metrics = getattr(self.server, "metrics", None)
+        if metrics is not None:
+            metrics.request_deadline_exceeded.labels(stage="http").inc()
 
     # ---------------------------------------------------------------- auth
 
@@ -817,7 +924,7 @@ class ApiServer:
                 claims.user_id,
                 collection,
                 user_id=user_id or None,
-                limit=int(request.query.get("limit", 100)),
+                limit=_limit(request.query),
                 cursor=request.query.get("cursor", ""),
             )
             return web.json_response(
@@ -915,7 +1022,7 @@ class ApiServer:
         try:
             self._session(request)
             q = request.query
-            limit = int(q.get("limit", 10))
+            limit = _limit(q, default=10)
             matches = self.server.match_registry.list_matches(
                 limit,
                 label=q.get("label") or None,
@@ -1013,7 +1120,7 @@ class ApiServer:
             q = request.query
             result = await self.server.purchases.list_subscriptions(
                 claims.user_id,
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 cursor=q.get("cursor", ""),
             )
             return web.json_response(result)
@@ -1028,7 +1135,7 @@ class ApiServer:
             q = request.query
             result = await self.server.notifications.list(
                 claims.user_id,
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 cursor=q.get("cacheable_cursor", q.get("cursor", "")),
             )
             return web.json_response(result)
@@ -1148,7 +1255,7 @@ class ApiServer:
             q = request.query
             result = await self.server.friends.list(
                 claims.user_id,
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 state=int(q["state"]) if "state" in q else None,
                 cursor=q.get("cursor", ""),
             )
@@ -1224,7 +1331,7 @@ class ApiServer:
             q = request.query
             result = await self.server.groups.list(
                 name=q.get("name") or None,
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 cursor=q.get("cursor", ""),
                 open=(
                     _parse_bool(q["open"]) if "open" in q else None
@@ -1289,7 +1396,7 @@ class ApiServer:
             q = request.query
             result = await self.server.groups.users_list(
                 request.match_info["group_id"],
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 state=int(q["state"]) if "state" in q else None,
                 cursor=q.get("cursor", ""),
             )
@@ -1304,7 +1411,7 @@ class ApiServer:
             user_id = request.match_info["user_id"] or claims.user_id
             result = await self.server.groups.user_groups_list(
                 user_id,
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 state=int(q["state"]) if "state" in q else None,
                 cursor=q.get("cursor", ""),
             )
@@ -1348,7 +1455,7 @@ class ApiServer:
             q = request.query
             result = await self.server.leaderboards.records_list(
                 request.match_info["id"],
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 cursor=q.get("cursor", ""),
                 owner_ids=q.getall("owner_ids", []) or None,
                 expiry_override=(
@@ -1394,7 +1501,7 @@ class ApiServer:
             result = await self.server.leaderboards.records_haystack(
                 request.match_info["id"],
                 request.match_info["owner_id"],
-                limit=int(request.query.get("limit", 100)),
+                limit=_limit(request.query),
             )
             return web.json_response(result)
         except Exception as e:
@@ -1435,7 +1542,7 @@ class ApiServer:
             q = request.query
             result = await self.server.channels.messages_list(
                 channel_id,
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 forward=_parse_bool(q.get("forward", "true")),
                 cursor=q.get("cursor", ""),
             )
@@ -1465,7 +1572,7 @@ class ApiServer:
             q = request.query
             result = await self.server.tournaments.records_list(
                 request.match_info["id"],
-                limit=int(q.get("limit", 100)),
+                limit=_limit(q),
                 cursor=q.get("cursor", ""),
             )
             return web.json_response(result)
@@ -1512,6 +1619,16 @@ class ApiServer:
 
         if isinstance(e, ApiError):
             return _error_response(str(e), e.status, e.grpc_code)
+        if isinstance(e, overload.DeadlineExceeded):
+            # A checkpoint deep in the stack (matchmaker add, storage
+            # submit/drain) short-circuited on the caller's deadline.
+            self._note_deadline()
+            return _error_response(str(e), 504, GRPC_DEADLINE_EXCEEDED)
+        if isinstance(e, overload.AdmissionRejected):
+            return _error_response(
+                str(e), 429, GRPC_RESOURCE_EXHAUSTED,
+                headers={"Retry-After": str(int(e.retry_after_sec))},
+            )
         from ..social.client import SocialError
 
         if isinstance(e, SocialError):
@@ -1544,3 +1661,19 @@ def _parse_bool(value: Any) -> bool:
     if isinstance(value, bool):
         return value
     return str(value).lower() in ("true", "1", "yes", "")
+
+
+def _limit(q, default: int = 100, hi: int = 1000) -> int:
+    """Clamp a `limit` query param to [1, hi]. A negative or huge limit
+    must never reach storage/leaderboard unvalidated, and a non-numeric
+    one is the client's 400, not our 500."""
+    raw = q.get("limit", default)
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        raise ApiError(
+            f"limit must be an integer, got {raw!r}",
+            400,
+            GRPC_INVALID_ARGUMENT,
+        )
+    return max(1, min(hi, value))

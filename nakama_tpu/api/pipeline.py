@@ -17,6 +17,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any
 
+from .. import overload
 from ..logger import Logger
 from ..match.party import PartyError
 from ..metrics import Metrics
@@ -58,6 +59,7 @@ class Components:
     runtime: Any = None
     session_registry: Any = None
     metrics: Metrics | None = None
+    overload: Any = None  # OverloadController (overload.py); None in tests
     extra: dict = field(default_factory=dict)
 
 
@@ -69,6 +71,55 @@ class Pipeline:
     # ------------------------------------------------------------ dispatch
 
     async def process(self, session, envelope: dict) -> bool:
+        """Entry from the socket read loop: realtime-class admission +
+        a per-envelope deadline (overload.py), then dispatch. Socket
+        ops are the HIGHEST priority class — under load the admission
+        controller sheds anonymous reads and queues RPCs before a
+        single realtime envelope waits — but they are still bounded:
+        past the realtime queue cap the envelope is answered with a
+        retryable error instead of queueing without limit."""
+        ov = self.c.overload
+        if ov is None:
+            return await self._dispatch(session, envelope)
+        cid = envelope.get("cid", "") if isinstance(envelope, dict) else ""
+        ocfg = getattr(self.c.config, "overload", None)
+        default_ms = (
+            (ocfg.deadline_realtime_ms or ocfg.deadline_default_ms)
+            if ocfg is not None
+            else 5_000
+        )
+        deadline = overload.Deadline(max(1, default_ms) / 1000.0)
+        try:
+            await ov.admission.admit(overload.REALTIME, deadline)
+        except overload.AdmissionRejected:
+            session.send(
+                error(
+                    ErrorCode.RUNTIME_EXCEPTION,
+                    "server overloaded, retry later",
+                    cid,
+                )
+            )
+            return True
+        except overload.DeadlineExceeded:
+            self._note_deadline()
+            session.send(
+                error(ErrorCode.RUNTIME_EXCEPTION, "deadline exceeded", cid)
+            )
+            return True
+        token = overload.set_deadline(deadline)
+        try:
+            return await self._dispatch(session, envelope)
+        finally:
+            overload.reset_deadline(token)
+            ov.admission.release()
+
+    def _note_deadline(self):
+        if self.c.metrics is not None:
+            self.c.metrics.request_deadline_exceeded.labels(
+                stage="pipeline"
+            ).inc()
+
+    async def _dispatch(self, session, envelope: dict) -> bool:
         key = message_key(envelope)
         cid = envelope.get("cid", "")
         if key is None:
@@ -122,6 +173,12 @@ class Pipeline:
             await _maybe_await(handler(session, cid, body))
         except PipelineError as e:
             session.send(error(e.code, str(e), cid))
+        except overload.DeadlineExceeded as e:
+            # A deep checkpoint (matchmaker add, storage submit) fired
+            # on this envelope's deadline: a retryable error, not an
+            # internal one.
+            self._note_deadline()
+            session.send(error(ErrorCode.RUNTIME_EXCEPTION, str(e), cid))
         except Exception as e:
             self.logger.error("pipeline handler error", key=key, error=str(e))
             session.send(error(ErrorCode.RUNTIME_EXCEPTION, "internal error", cid))

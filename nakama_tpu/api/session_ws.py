@@ -32,6 +32,7 @@ class WebSocketSession:
         logger: Logger,
         outgoing_queue_size: int = 64,
         on_close: Callable[["WebSocketSession"], Any] | None = None,
+        metrics: Any = None,
     ):
         self._id = str(uuid.uuid4())
         self.ws = ws
@@ -49,6 +50,13 @@ class WebSocketSession:
         self._writer_task: asyncio.Task | None = None
         self._closed = False
         self._on_close = on_close
+        self._metrics = metrics
+        # Per-session overflow ledger: envelopes dropped on a full
+        # outgoing queue (each one also counts in the
+        # session_outgoing_overflow_total{kind="drop"} metric; the
+        # close it triggers counts under kind="close").
+        self.overflow_drops = 0
+        self._overflow_closing = False
 
     # ------------------------------------------------------------ identity
 
@@ -77,11 +85,33 @@ class WebSocketSession:
             self._outgoing.put_nowait(envelope)
             return True
         except asyncio.QueueFull:
-            self.logger.warn("session outgoing queue full, closing")
+            self.overflow_drops += 1
+            self._note_overflow("drop")
+            if self._overflow_closing:
+                return False  # close already scheduled; just count
+            self._overflow_closing = True
+            self.logger.warn(
+                "session outgoing queue full, closing",
+                dropped=self.overflow_drops,
+            )
+            self._note_overflow("close")
+            # Deadline-bounded overflow close: the writer is already
+            # failing to keep up, so waiting the full flush grace for
+            # it would just stack more queued work behind a dead
+            # consumer — bound the flush to a short budget.
             asyncio.get_running_loop().create_task(
-                self.close("outgoing queue full")
+                self.close("outgoing queue full", flush_timeout=0.25)
             )
             return False
+
+    def _note_overflow(self, kind: str) -> None:
+        if self._metrics is not None:
+            try:
+                self._metrics.session_outgoing_overflow.labels(
+                    kind=kind
+                ).inc()
+            except Exception:
+                pass
 
     async def _writer(self):
         try:
@@ -120,7 +150,7 @@ class WebSocketSession:
         finally:
             await self.close("connection closed")
 
-    async def close(self, reason: str = ""):
+    async def close(self, reason: str = "", flush_timeout: float = 1.0):
         if self._closed:
             return
         self._closed = True
@@ -131,13 +161,19 @@ class WebSocketSession:
                 # just drop the handle.
                 self._writer_task = None
             else:
-                # Let queued messages flush briefly, then stop the writer.
+                # Let queued messages flush briefly, then stop the
+                # writer. `flush_timeout` bounds the grace — the
+                # overflow close path passes a short budget because a
+                # writer that overflowed its queue has already proven
+                # it cannot drain in time.
                 try:
                     self._outgoing.put_nowait(None)
                 except asyncio.QueueFull:
                     self._writer_task.cancel()
                 try:
-                    await asyncio.wait_for(self._writer_task, timeout=1.0)
+                    await asyncio.wait_for(
+                        self._writer_task, timeout=flush_timeout
+                    )
                 except (asyncio.TimeoutError, asyncio.CancelledError):
                     self._writer_task.cancel()
                 self._writer_task = None

@@ -92,6 +92,7 @@ class SocketAcceptor:
             logger=self.logger,
             outgoing_queue_size=self.config.socket.outgoing_queue_size,
             on_close=self._session_closed,
+            metrics=self.metrics,
         )
         session.token_id = claims.token_id  # for token invalidation
 

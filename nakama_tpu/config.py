@@ -272,6 +272,57 @@ class SatoriConfig:
 
 
 @dataclass
+class OverloadConfig:
+    """Overload-control plane (overload.py): admission control, deadline
+    propagation, prioritized shedding. Defaults are the disarmed
+    production posture — deadlines propagate and admission is bounded,
+    but the bounds are wide enough that an unloaded server never queues
+    (the bench's --overload mode measures the <=1% request-path
+    budget)."""
+
+    enabled: bool = True
+    # Server-wide concurrent-request permits shared by all three
+    # priority classes (realtime socket ops > authenticated RPC/storage
+    # > anonymous list/read endpoints).
+    admission_max_concurrent: int = 256
+    # Bounded per-class wait queues; a full queue rejects with 429 +
+    # Retry-After (gRPC RESOURCE_EXHAUSTED). WARN halves these and
+    # stops queueing the list class; SHED rejects the list class
+    # outright.
+    admission_queue_realtime: int = 512
+    admission_queue_rpc: int = 256
+    admission_queue_list: int = 64
+    retry_after_sec: int = 1
+    # Per-class request deadline defaults (ms), used when the client
+    # sent no grpc-timeout / X-Request-Timeout header; 0 falls back to
+    # deadline_default_ms. Expired deadlines short-circuit with 504 /
+    # DEADLINE_EXCEEDED before doing dead work, and the storage write
+    # batcher drops queued units whose caller deadline passed.
+    deadline_default_ms: int = 10_000
+    deadline_realtime_ms: int = 5_000
+    deadline_rpc_ms: int = 0
+    deadline_list_ms: int = 0
+    # Token-bucket per-key (ip+token) rate limiter generalizing the
+    # LoginAttemptCache tiers; 0 rps = disabled (the default: the
+    # admission queues are the primary bound).
+    rate_limit_rps: float = 0.0
+    rate_limit_burst: int = 32
+    # Load-level ladder (OK→WARN→SHED): sampled every ladder_sample_ms;
+    # escalation is immediate, de-escalation needs
+    # ladder_recover_samples consecutive calmer samples.
+    ladder_sample_ms: int = 250
+    ladder_recover_samples: int = 3
+    # db_write_queue_depth thresholds as fractions of
+    # database.write_queue_depth.
+    shed_queue_depth_warn: float = 0.5
+    shed_queue_depth_shed: float = 0.9
+    # Matchmaker interval-lag thresholds (seconds past the head
+    # cohort's delivery deadline).
+    interval_lag_warn_sec: float = 2.0
+    interval_lag_shed_sec: float = 15.0
+
+
+@dataclass
 class SocialConfig:
     steam_app_id: int = 0
     steam_publisher_key: str = ""
@@ -298,6 +349,7 @@ class Config:
     iap: IAPConfig = field(default_factory=IAPConfig)
     social: SocialConfig = field(default_factory=SocialConfig)
     satori: SatoriConfig = field(default_factory=SatoriConfig)
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
 
     @property
     def node(self) -> str:
@@ -320,6 +372,18 @@ class Config:
             raise ValueError("matchmaker.max_intervals must be >= 1")
         if self.socket.port == self.console.port:
             raise ValueError("socket.port and console.port must differ")
+        if self.overload.admission_max_concurrent < 1:
+            raise ValueError(
+                "overload.admission_max_concurrent must be >= 1"
+            )
+        if not (
+            0.0 < self.overload.shed_queue_depth_warn
+            <= self.overload.shed_queue_depth_shed
+        ):
+            warnings.append(
+                "overload.shed_queue_depth_warn should be in"
+                " (0, shed_queue_depth_shed]"
+            )
         return warnings
 
 
@@ -501,6 +565,7 @@ __all__ = [
     "LeaderboardConfig",
     "IAPConfig",
     "SocialConfig",
+    "OverloadConfig",
     "load_config",
     "parse_args",
     "config_to_dict",

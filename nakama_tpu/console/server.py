@@ -57,6 +57,7 @@ class ConsoleServer:
         self.metrics_port: int | None = None
         r.add_post("/v2/console/authenticate", self._h_authenticate)
         r.add_get("/v2/console/status", self._h_status)
+        r.add_get("/v2/console/overload", self._h_overload)
         r.add_get("/v2/console/config", self._h_config)
         r.add_get("/v2/console/runtime", self._h_runtime)
         r.add_get("/", self._h_ui)
@@ -305,7 +306,35 @@ class ConsoleServer:
                 "presences": s.tracker.count(),
                 "matches": len(s.match_registry),
                 "matchmaker_tickets": len(s.matchmaker),
+                "overload_state": (
+                    s.overload.stats()["state"]
+                    if getattr(s, "overload", None) is not None
+                    else "disabled"
+                ),
                 "config_warnings": self.config.check(),
+            }
+        )
+
+    async def _h_overload(self, request: web.Request):
+        """Overload-plane dashboard: ladder state + per-signal levels,
+        admission stats (inflight, queues, shed totals by class and
+        reason), and the recent transition ledger — the operator's
+        "why are we returning 429s" page."""
+        self._auth(request)
+        s = self.server
+        ov = getattr(s, "overload", None)
+        if ov is None:
+            return web.json_response({"enabled": False})
+        tracing = getattr(s, "_overload_tracing", None)
+        return web.json_response(
+            {
+                "enabled": True,
+                **ov.stats(),
+                "recent_transitions": (
+                    tracing.recent_overload_events()
+                    if tracing is not None
+                    else []
+                ),
             }
         )
 

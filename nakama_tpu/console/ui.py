@@ -62,6 +62,7 @@ const R = {
   authenticate:     ['POST',   '/v2/console/authenticate'],
   logout:           ['POST',   '/v2/console/authenticate/logout'],
   status:           ['GET',    '/v2/console/status'],
+  overload:         ['GET',    '/v2/console/overload'],
   config:           ['GET',    '/v2/console/config'],
   runtime:          ['GET',    '/v2/console/runtime'],
   accountList:      ['GET',    '/v2/console/account'],
@@ -372,10 +373,11 @@ async function groupDetail(el, id) {
 
 const TABS = {
   status: async (el) => {
-    const [s, rt] = await Promise.all([
-      call('status'), call('runtime'),
+    const [s, ov, rt] = await Promise.all([
+      call('status'), call('overload'), call('runtime'),
     ]);
     el.appendChild($(`<h4>status</h4>${jpre(s)}
+      <h4>overload</h4>${jpre(ov)}
       <h4>runtime</h4>${jpre(rt)}`));
   },
   accounts: async (el) => {

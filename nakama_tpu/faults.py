@@ -50,6 +50,8 @@ FAULT_POINTS = (
     "db.read",           # ReadCoalescer drain worker, per chunk
     "pg.commit",         # PG group commit, pre-COMMIT (connection loss)
     "delivery.publish",  # LocalMatchmaker on_matched delivery
+    "api.admit",         # AdmissionController.try_admit (overload.py)
+    "overload.signal",   # ladder sample; drop mode forces a SHED sample
 )
 
 

@@ -30,7 +30,7 @@ from typing import Callable, Protocol
 
 import numpy as np
 
-from .. import faults
+from .. import faults, overload
 from ..config import MatchmakerConfig
 from ..logger import Logger
 from ..metrics import Metrics
@@ -561,6 +561,19 @@ class LocalMatchmaker:
         Reference Add: server/matchmaker.go:443-566."""
         if self._stopped:
             raise ErrNotAvailable("matchmaker stopped")
+        # Deadline propagation (overload.py): a caller whose deadline
+        # already passed gets DEADLINE_EXCEEDED before the ticket is
+        # registered — registering it would be dead work the client has
+        # already given up on (their retry re-adds it).
+        dl = overload.current_deadline()
+        if dl is not None and dl.expired():
+            if self.metrics is not None:
+                self.metrics.request_deadline_exceeded.labels(
+                    stage="matchmaker"
+                ).inc()
+            raise overload.DeadlineExceeded(
+                "caller deadline expired before matchmaker add"
+            )
         if not presences:
             raise MatchmakerError("at least one presence required")
         if count_multiple < 1:

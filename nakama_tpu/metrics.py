@@ -175,9 +175,39 @@ class Metrics:
             ("point", "mode"),
         )
 
+        # Overload-control plane (overload.py): the ladder state, what
+        # was shed and why, deadline short-circuits by checkpoint stage,
+        # and the admission controller's live concurrency.
+        self.overload_state = gauge(
+            "overload_state",
+            "Load-level ladder state (0 ok, 1 warn, 2 shed)",
+        )
+        self.requests_shed = counter(
+            "requests_shed",
+            "Requests rejected by admission control, by priority class "
+            "and reason (queue_full, warn, shed, rate_limited)",
+            ("class", "reason"),
+        )
+        self.request_deadline_exceeded = counter(
+            "request_deadline_exceeded",
+            "Requests short-circuited on an expired deadline, by "
+            "checkpoint stage (http, pipeline, matchmaker, db)",
+            ("stage",),
+        )
+        self.admission_inflight = gauge(
+            "admission_inflight",
+            "Requests currently holding an admission permit",
+        )
+
         # Message routing / presence events.
         self.outgoing_dropped = counter(
             "socket_outgoing_dropped", "Messages dropped on full session queues"
+        )
+        self.session_outgoing_overflow = counter(
+            "session_outgoing_overflow",
+            "Per-session outgoing-queue overflow events: dropped "
+            "envelopes and the queue-full session closes they trigger",
+            ("kind",),
         )
         self.presence_event_time = histo(
             "presence_event_sec", "Tracker event queue latency"

@@ -123,6 +123,46 @@ class NakamaServer:
                 metrics=self.metrics,
                 tracing=getattr(self.matchmaker.backend, "tracing", None),
             )
+        # Overload-control plane (overload.py): built here so the API
+        # server and pipeline can reference it; signals are registered
+        # and the ladder sampler started in start() once the components
+        # they read exist. `overload.enabled=False` leaves the front
+        # doors completely unwired (self.overload None = no admission,
+        # no deadlines — the pre-overload behavior).
+        from . import overload as overload_mod
+        from .tracing import Tracing
+
+        self.overload = None
+        self._overload_tracing = getattr(
+            self.matchmaker.backend, "tracing", None
+        ) or Tracing(logger=log)
+        if config.overload.enabled:
+            oc = config.overload
+            admission = overload_mod.AdmissionController(
+                oc.admission_max_concurrent,
+                {
+                    overload_mod.REALTIME: oc.admission_queue_realtime,
+                    overload_mod.RPC: oc.admission_queue_rpc,
+                    overload_mod.LIST: oc.admission_queue_list,
+                },
+                retry_after_sec=oc.retry_after_sec,
+                metrics=self.metrics,
+            )
+            limiter = (
+                overload_mod.RateLimiter(
+                    oc.rate_limit_rps, oc.rate_limit_burst
+                )
+                if oc.rate_limit_rps > 0
+                else None
+            )
+            self.overload = overload_mod.OverloadController(
+                admission,
+                limiter,
+                recover_samples=oc.ladder_recover_samples,
+                logger=log.with_fields(subsystem="overload"),
+                metrics=self.metrics,
+                tracing=self._overload_tracing,
+            )
         self.runtime = None
         self.matchmaker.on_matched = make_matched_handler(
             log,
@@ -174,6 +214,7 @@ class NakamaServer:
                 groups=self.groups,
                 db=self.db,
                 metrics=self.metrics,
+                overload=self.overload,
             ),
         )
         self.acceptor = SocketAcceptor(
@@ -297,6 +338,61 @@ class NakamaServer:
         self.google_refund_scheduler.start()
         self.tracker.start()
         self.matchmaker.start()
+        if self.overload is not None:
+            # Ladder signals read components that now exist: storage
+            # write-queue depth (PR 2's gauge, read directly), the
+            # device backend's breaker (PR 3), and matchmaker delivery
+            # lag (PR 4's cohort deadlines).
+            from . import overload as overload_mod
+
+            oc = self.config.overload
+            batcher = getattr(self.db, "_batcher", None)
+            if batcher is not None:
+                self.overload.register_signal(
+                    "db_write_queue_depth",
+                    overload_mod.db_queue_signal(
+                        lambda: batcher.depth,
+                        self.config.database.write_queue_depth,
+                        oc.shed_queue_depth_warn,
+                        oc.shed_queue_depth_shed,
+                    ),
+                )
+            if getattr(self.matchmaker.backend, "breaker", None) is not None:
+                self.overload.register_signal(
+                    "backend_breaker",
+                    overload_mod.breaker_signal(
+                        lambda: getattr(
+                            self.matchmaker.backend, "breaker", None
+                        )
+                    ),
+                )
+            self.overload.register_signal(
+                "matchmaker_interval_lag",
+                overload_mod.interval_lag_signal(
+                    self.matchmaker._next_cohort_deadline,
+                    oc.interval_lag_warn_sec,
+                    oc.interval_lag_shed_sec,
+                ),
+            )
+            self.overload.start(max(50, oc.ladder_sample_ms) / 1000.0)
+            # The admission posture in one line, like PR 4's delivery
+            # line: an operator diagnosing 429s/504s reads the
+            # effective knobs off the boot log.
+            self.logger.info(
+                "overload control enabled",
+                max_concurrent=oc.admission_max_concurrent,
+                queues=dict(
+                    realtime=oc.admission_queue_realtime,
+                    rpc=oc.admission_queue_rpc,
+                    list=oc.admission_queue_list,
+                ),
+                deadline_default_ms=oc.deadline_default_ms,
+                deadline_realtime_ms=oc.deadline_realtime_ms,
+                rate_limit_rps=oc.rate_limit_rps,
+                rate_limit_burst=oc.rate_limit_burst,
+                ladder_sample_ms=oc.ladder_sample_ms,
+                ladder_recover_samples=oc.ladder_recover_samples,
+            )
         mm_cfg = self.config.matchmaker
         if mm_cfg.interval_pipelining:
             # The delivery posture in one line: operators diagnosing a
@@ -362,6 +458,8 @@ class NakamaServer:
         if self.grpc is not None:
             await self.grpc.stop()
             self.grpc = None
+        if self.overload is not None:
+            self.overload.stop()
         await self.console.stop()
         await self.api.stop()
         await self.match_registry.stop_all(grace)

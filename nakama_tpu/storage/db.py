@@ -54,7 +54,7 @@ import threading
 import time
 from typing import Any, Iterable, Sequence
 
-from .. import faults
+from .. import faults, overload
 from ..faults import jittered_backoff
 from .migrations import MIGRATIONS
 
@@ -88,12 +88,16 @@ class WriteConflictError(DatabaseError):
 
 
 class _WriteUnit:
-    __slots__ = ("stmts", "guards", "future")
+    __slots__ = ("stmts", "guards", "future", "deadline")
 
-    def __init__(self, stmts, guards, future):
+    def __init__(self, stmts, guards, future, deadline=None):
         self.stmts = stmts
         self.guards = guards
         self.future = future
+        # The submitting request's overload.Deadline (None when the
+        # caller carries none): the drain drops the unit instead of
+        # committing a write nobody is waiting for.
+        self.deadline = deadline
 
 
 class _GroupAborted(Exception):
@@ -143,6 +147,7 @@ class WriteBatcher:
         self.group_commits = 0
         self.units_committed = 0
         self.units_conflicted = 0
+        self.units_expired = 0  # deadline-dropped before execution
         self.batch_size_counts: collections.Counter = collections.Counter()
 
     def stats(self) -> dict:
@@ -150,6 +155,7 @@ class WriteBatcher:
             "group_commits": self.group_commits,
             "units_committed": self.units_committed,
             "units_conflicted": self.units_conflicted,
+            "units_expired": self.units_expired,
             "batch_sizes": dict(self.batch_size_counts),
             "drain_restarts": self.drain_restarts,
         }
@@ -169,6 +175,12 @@ class WriteBatcher:
             guards = (False,) * len(stmts)
         if self._db.group_commit:
             return await self.submit(stmts, guards)
+        deadline = overload.current_deadline()
+        if deadline is not None and deadline.expired():
+            self._note_expired()
+            raise overload.DeadlineExceeded(
+                "caller deadline expired before write"
+            )
         async with self._db._lock:
             results = await self._db._run_write_group(
                 [_WriteUnit(stmts, guards, None)]
@@ -186,10 +198,19 @@ class WriteBatcher:
             )
         if getattr(self._db, "_closing", False):
             raise DatabaseError("database closing")
+        # Deadline propagation (overload.py): an already-expired caller
+        # short-circuits BEFORE taking a queue slot — the 504 is going
+        # out either way, so the write must not occupy the pipeline.
+        deadline = overload.current_deadline()
+        if deadline is not None and deadline.expired():
+            self._note_expired()
+            raise overload.DeadlineExceeded(
+                "caller deadline expired before write submit"
+            )
         await self._sem.acquire()
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._queue.append(_WriteUnit(stmts, guards, fut))
+        self._queue.append(_WriteUnit(stmts, guards, fut, deadline))
         metrics = self._db.metrics
         if metrics is not None:
             metrics.db_write_queue_depth.set(len(self._queue))
@@ -241,8 +262,24 @@ class WriteBatcher:
                 while self._queue and len(batch) < self.batch_max:
                     unit = self._queue.popleft()
                     self._sem.release()
-                    if not unit.future.done():  # caller gone: skip
-                        batch.append(unit)
+                    if unit.future.done():  # caller gone: skip
+                        continue
+                    if (
+                        unit.deadline is not None
+                        and unit.deadline.expired()
+                    ):
+                        # The caller's deadline passed while the unit
+                        # queued (stalled drain, deep backlog): dead
+                        # work — drop it instead of committing a write
+                        # nobody awaits anymore.
+                        unit.future.set_exception(
+                            overload.DeadlineExceeded(
+                                "caller deadline expired in write queue"
+                            )
+                        )
+                        self._note_expired()
+                        continue
+                    batch.append(unit)
                 if not batch:
                     continue
                 self._inflight = batch
@@ -311,6 +348,17 @@ class WriteBatcher:
                 self._crash_streak, DRAIN_BACKOFF_BASE_S,
                 DRAIN_BACKOFF_MAX_S,
             )
+
+    def _note_expired(self) -> None:
+        """Count a deadline-dropped write unit (`request_deadline_exceeded`
+        stage=db) — observability only, never the failure path itself."""
+        self.units_expired += 1
+        metrics = self._db.metrics
+        if metrics is not None:
+            try:
+                metrics.request_deadline_exceeded.labels(stage="db").inc()
+            except Exception:
+                pass
 
     def _note(self, batch_len: int, ok_count: int, dt: float) -> None:
         self.group_commits += 1

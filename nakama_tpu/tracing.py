@@ -41,6 +41,10 @@ class Tracing:
         # in-flight cohort reclamations, so an operator can read the
         # outage timeline off the ledger instead of correlating logs.
         self.breaker_events: deque[dict] = deque(maxlen=capacity)
+        # Overload-ladder transitions (overload.py OverloadController):
+        # OK→WARN→SHED flips with the per-signal levels that drove
+        # them, so "why did we shed at 14:02" reads off the ledger.
+        self.overload_events: deque[dict] = deque(maxlen=capacity)
         if port:
             self.start_profiler_server(port)
 
@@ -180,3 +184,14 @@ class Tracing:
 
     def recent_breaker_events(self, n: int = 32) -> list[dict]:
         return list(self.breaker_events)[-n:]
+
+    # ------------------------------------------------- overload ladder
+
+    def record_overload(self, **fields):
+        """One overload-ladder transition (overload.py): old/new level
+        and the per-signal levels at the sample that drove it."""
+        fields.setdefault("ts", time.time())
+        self.overload_events.append(fields)
+
+    def recent_overload_events(self, n: int = 32) -> list[dict]:
+        return list(self.overload_events)[-n:]

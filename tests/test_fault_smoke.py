@@ -138,10 +138,61 @@ async def smoke_pg() -> dict:
     return {"count": n, "rows": len(rows), "breaker": state}
 
 
+def smoke_overload() -> dict:
+    """ISSUE 5: the overload fault points. An armed `overload.signal`
+    drop forces the ladder to SHED (lowest class rejected outright)
+    and the ladder recovers through hysteresis once disarmed; an armed
+    `api.admit` raise is survived with admission books balanced."""
+    from nakama_tpu import faults
+    from nakama_tpu.overload import (
+        LIST,
+        OK,
+        REALTIME,
+        RPC,
+        SHED,
+        AdmissionController,
+        AdmissionRejected,
+        OverloadController,
+    )
+
+    adm = AdmissionController(2, {REALTIME: 2, RPC: 2, LIST: 2})
+    ov = OverloadController(adm, recover_samples=2)
+    faults.arm("overload.signal", "drop", count=1)
+    shed_reached = ov.sample() == SHED
+    list_rejected = 0
+    try:
+        adm.try_admit(LIST)
+    except AdmissionRejected:
+        list_rejected = 1
+    samples = 0
+    while ov.state != OK and samples < 10:
+        ov.sample()
+        samples += 1
+    faults.arm("api.admit", "raise", count=1)
+    admit_fault = 0
+    try:
+        adm.try_admit(RPC)
+    except faults.InjectedFault:
+        admit_fault = 1
+    adm.try_admit(RPC)  # disarmed again: admits normally
+    adm.release()
+    return {
+        "shed_reached": shed_reached,
+        "list_rejected": list_rejected,
+        "recovered": int(ov.state == OK),
+        "recover_samples": samples,
+        "admit_fault": admit_fault,
+        "inflight": adm.inflight,
+        "fired_signal": faults.PLANE.fired.get("overload.signal", 0),
+        "fired_admit": faults.PLANE.fired.get("api.admit", 0),
+    }
+
+
 def _smoke_all() -> dict:
     out = {"matchmaker": smoke_matchmaker()}
     out["storage"] = asyncio.run(smoke_storage())
     out["pg"] = asyncio.run(smoke_pg())
+    out["overload"] = smoke_overload()
     return out
 
 
@@ -185,3 +236,9 @@ def test_fault_smoke_subprocess_isolated():
     p = out["pg"]
     assert p["count"] == 1 and p["rows"] == 1
     assert p["breaker"] == "closed"
+
+    o = out["overload"]
+    assert o["fired_signal"] == 1 and o["fired_admit"] == 1
+    assert o["shed_reached"] and o["list_rejected"] == 1
+    assert o["recovered"] == 1 and o["recover_samples"] <= 3
+    assert o["admit_fault"] == 1 and o["inflight"] == 0

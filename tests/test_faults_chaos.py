@@ -662,6 +662,99 @@ async def test_pg_retries_exhausted_then_breaker_fails_fast():
     await srv.stop()
 
 
+# ------------------------------------------- deadline propagation (e2e)
+
+
+async def test_http_deadline_504_against_stalled_drain():
+    """ISSUE 5 end-to-end deadline contract: an HTTP request carrying a
+    50ms deadline against a stalled `db.drain` must come back 504
+    without its write ever executing or holding a queue slot — the
+    deadline plane short-circuits the dead work at the front door AND
+    the storage drain drops the abandoned unit."""
+    import base64
+
+    import aiohttp
+
+    from nakama_tpu.config import Config
+    from nakama_tpu.server import NakamaServer
+
+    config = Config()
+    config.socket.port = 0
+    config.socket.grpc_port = -1  # loopback gRPC not under test here
+    server = NakamaServer(config, quiet_logger())
+    await server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        basic = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"defaultkey:").decode()
+        }
+        async with aiohttp.ClientSession() as http:
+            r = await http.post(
+                f"{base}/v2/account/authenticate/device",
+                json={"id": "device-deadline-e2e"},
+                headers=basic,
+            )
+            assert r.status == 200
+            bearer = {
+                "Authorization": f"Bearer {(await r.json())['token']}"
+            }
+            # Stall the drain: the armed db.drain point fires on the
+            # pop, and a slow scalar function keeps the WRITER THREAD
+            # (not the event loop) busy for 600ms, so the queued-unit
+            # window is real while the server stays responsive.
+            await server.db._run(
+                lambda: server.db._conn.create_function(
+                    "nk_slow", 1,
+                    lambda s: __import__("time").sleep(s) or 1,
+                )
+            )
+            faults.arm("db.drain", "stall", stall_s=0.01, count=10)
+            slow = asyncio.create_task(
+                server.db.execute("SELECT nk_slow(0.6)")
+            )
+            await asyncio.sleep(0.05)  # drain popped the slow unit
+            t0 = time.perf_counter()
+            r = await http.put(
+                f"{base}/v2/storage",
+                json={
+                    "objects": [
+                        {"collection": "c", "key": "dead", "value": "{}"}
+                    ]
+                },
+                headers={**bearer, "X-Request-Timeout": "50"},
+            )
+            elapsed = time.perf_counter() - t0
+            assert r.status == 504, await r.text()
+            assert elapsed < 0.5  # short-circuited, not drain-paced
+            await slow
+            await server.db._batcher.flush()
+            assert server.db._batcher.depth == 0  # slot released
+            assert faults.PLANE.fired.get("db.drain", 0) >= 1
+            faults.disarm()
+            # The dead write never executed...
+            r = await http.post(
+                f"{base}/v2/storage",
+                json={"object_ids": [{"collection": "c", "key": "dead"}]},
+                headers=bearer,
+            )
+            assert (await r.json()).get("objects", []) == []
+            # ...and the pipeline is healthy: a fresh write commits.
+            r = await http.put(
+                f"{base}/v2/storage",
+                json={
+                    "objects": [
+                        {"collection": "c", "key": "alive", "value": "{}"}
+                    ]
+                },
+                headers=bearer,
+            )
+            assert r.status == 200
+    finally:
+        faults.disarm()
+        await server.stop()
+
+
 # ------------------------------------------------------------- chaos soak
 
 

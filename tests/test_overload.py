@@ -1,0 +1,568 @@
+"""Overload-control plane coverage (ISSUE 5).
+
+Deterministic proofs for overload.py and its wiring:
+
+- Deadline: grpc-timeout / X-Request-Timeout parsing, per-class
+  defaults, contextvar propagation, expiry checkpoints.
+- AdmissionController: strict priority+FIFO grants, bounded per-class
+  queues, fast rejection, deadline-bounded waits, the dead-waiter
+  queue-head trim, WARN/SHED policy tightening, the SHED flush.
+- RateLimiter: token-bucket refill and bounded key table.
+- OverloadController: signal max, escalate-now/recover-with-hysteresis,
+  the forced-SHED `overload.signal` fault point, metrics + tracing
+  ledger transitions.
+- Storage: queued write units whose caller deadline passed are dropped
+  by the drain (never executed, never hung); expired-before-submit
+  short-circuits without a queue slot.
+- Matchmaker: an expired caller deadline fails add() before a ticket
+  registers.
+- Pipeline: realtime envelopes get admission; a rejected envelope is
+  answered with a retryable error, not a dropped socket.
+- HTTP helpers: the [1, 1000] limit clamp, 400 on non-numeric.
+- The bench's named `overload_regression` gate (PR 4's
+  cadence_regression discipline: tier-1-tested so it cannot rot).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from nakama_tpu import faults, overload
+from nakama_tpu.config import Config, MatchmakerConfig
+from nakama_tpu.logger import test_logger as quiet_logger
+from nakama_tpu.metrics import Metrics
+from nakama_tpu.overload import (
+    LIST,
+    OK,
+    REALTIME,
+    RPC,
+    SHED,
+    WARN,
+    AdmissionController,
+    AdmissionRejected,
+    Deadline,
+    DeadlineExceeded,
+    OverloadController,
+    RateLimiter,
+    deadline_from_headers,
+    parse_grpc_timeout,
+)
+from nakama_tpu.tracing import Tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------------- deadlines
+
+
+def test_parse_grpc_timeout_units():
+    assert parse_grpc_timeout("100m") == pytest.approx(0.1)
+    assert parse_grpc_timeout("5S") == pytest.approx(5.0)
+    assert parse_grpc_timeout("2M") == pytest.approx(120.0)
+    assert parse_grpc_timeout("1H") == pytest.approx(3600.0)
+    assert parse_grpc_timeout("500u") == pytest.approx(0.0005)
+    for bad in ("", "m", "100", "abcm", "-5S"):
+        with pytest.raises(ValueError):
+            parse_grpc_timeout(bad)
+
+
+def test_deadline_from_headers_precedence_and_default():
+    dl = deadline_from_headers({"grpc-timeout": "50m"}, 10_000)
+    assert dl.explicit and 0.0 < dl.remaining() <= 0.05
+    dl = deadline_from_headers({"X-Request-Timeout": "250"}, 10_000)
+    assert dl.explicit and 0.2 < dl.remaining() <= 0.25
+    # grpc-timeout wins over X-Request-Timeout.
+    dl = deadline_from_headers(
+        {"grpc-timeout": "1S", "X-Request-Timeout": "9000"}, 10_000
+    )
+    assert dl.remaining() <= 1.0
+    dl = deadline_from_headers({}, 10_000)
+    assert not dl.explicit and 9.9 < dl.remaining() <= 10.0
+    with pytest.raises(ValueError):
+        deadline_from_headers({"X-Request-Timeout": "soon"}, 10_000)
+    with pytest.raises(ValueError):
+        deadline_from_headers({"X-Request-Timeout": "-50"}, 10_000)
+
+
+def test_deadline_contextvar_propagation():
+    assert overload.current_deadline() is None
+    with overload.deadline_scope(Deadline(10.0)) as dl:
+        assert overload.current_deadline() is dl
+        overload.check_deadline()  # not expired: no raise
+        with overload.deadline_scope(Deadline(0.0)):
+            with pytest.raises(DeadlineExceeded):
+                overload.check_deadline("test")
+        assert overload.current_deadline() is dl
+    assert overload.current_deadline() is None
+
+
+# ------------------------------------------------------------- admission
+
+
+async def test_admission_priority_and_queue_bounds():
+    adm = AdmissionController(2, {REALTIME: 4, RPC: 2, LIST: 1})
+    await adm.admit(RPC)
+    await adm.admit(RPC)
+    t_rpc = asyncio.create_task(adm.admit(RPC))
+    t_list = asyncio.create_task(adm.admit(LIST))
+    await asyncio.sleep(0)
+    t_rt = asyncio.create_task(adm.admit(REALTIME))
+    await asyncio.sleep(0)
+    # LIST queue cap is 1 and it holds a waiter: the next is rejected,
+    # synchronously and with the retry hint.
+    with pytest.raises(AdmissionRejected) as ei:
+        await adm.admit(LIST)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_sec > 0
+    # Releases grant strictly by priority even though realtime arrived
+    # last.
+    adm.release()
+    await asyncio.sleep(0)
+    assert t_rt.done() and not t_rpc.done() and not t_list.done()
+    adm.release()
+    await asyncio.sleep(0)
+    assert t_rpc.done() and not t_list.done()
+    adm.release()
+    await asyncio.sleep(0)
+    assert t_list.done()
+    for _ in range(2):
+        adm.release()
+    assert adm.inflight == 0
+    assert adm.admitted_total == 5
+    assert adm.shed_total == 1
+
+
+async def test_admission_deadline_bounded_wait():
+    adm = AdmissionController(1, {REALTIME: 4, RPC: 4, LIST: 4})
+    await adm.admit(RPC)
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceeded):
+        await adm.admit(RPC, Deadline(0.05))
+    assert time.perf_counter() - t0 < 1.0
+    # An expired deadline never waits at all.
+    with pytest.raises(DeadlineExceeded):
+        await adm.admit(RPC, Deadline(0.0))
+    adm.release()
+    assert adm.inflight == 0
+
+
+async def test_admission_dead_waiter_heads_do_not_deadlock():
+    """A queue holding only timed-out waiters must read as uncontended:
+    the next arrival takes a free permit instead of parking behind
+    ghosts no release will ever grant."""
+    adm = AdmissionController(1, {REALTIME: 4, RPC: 4, LIST: 4})
+    await adm.admit(RPC)
+    with pytest.raises(DeadlineExceeded):
+        await adm.admit(RPC, Deadline(0.01))
+    adm.release()  # permit free, dead waiter still parked
+    await asyncio.wait_for(adm.admit(RPC), 1.0)
+    adm.release()
+    assert adm.inflight == 0
+
+
+async def test_admission_warn_and_shed_policy():
+    metrics = Metrics()
+    adm = AdmissionController(
+        1, {REALTIME: 8, RPC: 8, LIST: 8}, metrics=metrics
+    )
+    await adm.admit(RPC)
+    # WARN: the lowest class no longer queues.
+    adm.set_level(WARN)
+    with pytest.raises(AdmissionRejected) as ei:
+        await adm.admit(LIST)
+    assert ei.value.reason == "warn"
+    # ...but an immediately-free permit still admits LIST under WARN.
+    adm.release()
+    await adm.admit(LIST)
+    adm.release()
+    # SHED: LIST is rejected outright even with free permits, and
+    # parked LIST waiters are flushed with rejection.
+    adm.set_level(OK)
+    await adm.admit(RPC)
+    t_list = asyncio.create_task(adm.admit(LIST))
+    await asyncio.sleep(0)
+    adm.set_level(SHED)
+    await asyncio.sleep(0)
+    with pytest.raises(AdmissionRejected) as ei:
+        t_list.result()
+    assert ei.value.reason == "shed"
+    with pytest.raises(AdmissionRejected):
+        await adm.admit(LIST)
+    # Higher classes still admitted under SHED.
+    adm.release()
+    await adm.admit(REALTIME)
+    adm.release()
+    assert adm.inflight == 0
+    shed = metrics.snapshot().get(
+        'nakama_requests_shed_total{class=list,reason=shed}', 0
+    )
+    assert shed >= 2
+
+
+async def test_admission_grant_timeout_race_keeps_books_balanced():
+    """A waiter granted in the same loop step its deadline fires must
+    either keep the permit or hand it back — never leak it."""
+    adm = AdmissionController(1, {REALTIME: 4, RPC: 4, LIST: 4})
+    for _ in range(10):
+        await adm.admit(RPC)
+        waiter = asyncio.create_task(adm.admit(RPC, Deadline(0.005)))
+        await asyncio.sleep(0.005)
+        adm.release()  # may race the waiter's timeout
+        try:
+            await waiter
+            adm.release()  # waiter owned a permit
+        except DeadlineExceeded:
+            pass
+        await asyncio.sleep(0)
+    assert adm.inflight == 0
+    # The controller still serves.
+    await adm.admit(RPC)
+    adm.release()
+
+
+# ----------------------------------------------------------- rate limiter
+
+
+def test_rate_limiter_token_bucket():
+    rl = RateLimiter(rate=10.0, burst=2)
+    assert rl.allow("k") and rl.allow("k")
+    assert not rl.allow("k")
+    time.sleep(0.12)  # ~1.2 tokens refilled
+    assert rl.allow("k")
+    assert not rl.allow("k")
+    # Independent keys don't share buckets; rate 0 disables.
+    assert rl.allow("other")
+    assert RateLimiter(0.0, 1).allow("x")
+
+
+def test_rate_limiter_bounded_keys():
+    rl = RateLimiter(rate=1000.0, burst=1, max_keys=16)
+    for i in range(200):
+        rl.allow(f"k{i}")
+    assert len(rl._buckets) <= 17
+
+
+# ---------------------------------------------------------------- ladder
+
+
+def test_ladder_escalates_now_recovers_with_hysteresis():
+    metrics = Metrics()
+    tracing = Tracing()
+    adm = AdmissionController(4, {REALTIME: 4, RPC: 4, LIST: 4})
+    level = {"v": OK}
+    ov = OverloadController(
+        adm, recover_samples=3, metrics=metrics, tracing=tracing,
+        logger=quiet_logger(),
+    )
+    ov.register_signal("load", lambda: level["v"])
+    assert ov.sample() == OK
+    level["v"] = SHED
+    assert ov.sample() == SHED  # escalation is immediate
+    assert adm.level == SHED
+    level["v"] = OK
+    assert ov.sample() == SHED  # 1 calm sample: held
+    assert ov.sample() == SHED  # 2: held
+    assert ov.sample() == OK  # 3: recovered
+    assert adm.level == OK
+    assert metrics.snapshot()["nakama_overload_state"] == OK
+    events = tracing.recent_overload_events()
+    assert len(events) == 2
+    assert events[0]["new"] == "shed" and events[1]["new"] == "ok"
+
+
+def test_ladder_broken_signal_is_ok_not_shed():
+    adm = AdmissionController(4, {REALTIME: 4, RPC: 4, LIST: 4})
+    ov = OverloadController(adm)
+
+    def broken():
+        raise RuntimeError("signal backend gone")
+
+    ov.register_signal("broken", broken)
+    assert ov.sample() == OK
+
+
+def test_ladder_forced_shed_via_fault_point_recovers():
+    """The `overload.signal` chaos hook: one armed drop forces a SHED
+    sample without manufacturing real load, and the ladder recovers
+    through normal hysteresis once disarmed."""
+    adm = AdmissionController(4, {REALTIME: 4, RPC: 4, LIST: 4})
+    ov = OverloadController(adm, recover_samples=2)
+    faults.arm("overload.signal", "drop", count=1)
+    assert ov.sample() == SHED
+    with pytest.raises(AdmissionRejected):
+        adm.try_admit(LIST)
+    assert ov.sample() == SHED
+    assert ov.sample() == OK
+    assert faults.PLANE.fired.get("overload.signal", 0) == 1
+
+
+def test_ladder_signal_builders():
+    depth = {"v": 0}
+    sig = overload.db_queue_signal(lambda: depth["v"], 100, 0.5, 0.9)
+    assert sig() == OK
+    depth["v"] = 60
+    assert sig() == WARN
+    depth["v"] = 95
+    assert sig() == SHED
+
+    class _B:
+        state = "closed"
+
+    b = _B()
+    sig = overload.breaker_signal(lambda: b)
+    assert sig() == OK
+    b.state = "open"
+    assert sig() == WARN
+    assert overload.breaker_signal(lambda: None)() == OK
+
+    head = {"v": None}
+    sig = overload.interval_lag_signal(lambda: head["v"], 2.0, 15.0)
+    assert sig() == OK  # empty pipeline
+    head["v"] = time.perf_counter() + 10
+    assert sig() == OK  # not yet due
+    head["v"] = time.perf_counter() - 5
+    assert sig() == WARN
+    head["v"] = time.perf_counter() - 20
+    assert sig() == SHED
+
+
+# ----------------------------------------------------- storage deadlines
+
+
+async def test_write_expired_before_submit_takes_no_queue_slot():
+    from nakama_tpu.storage.db import Database
+
+    db = Database(":memory:")
+    await db.connect()
+    await db.execute("CREATE TABLE kv (k TEXT PRIMARY KEY, v INT)")
+    with overload.deadline_scope(Deadline(0.0)):
+        with pytest.raises(DeadlineExceeded):
+            await db.execute(
+                "INSERT INTO kv (k, v) VALUES ('dead', 1)"
+            )
+    assert db._batcher.depth == 0
+    assert db._batcher.units_expired == 1
+    rows = await db.fetch_all("SELECT k FROM kv")
+    assert rows == []
+    await db.close()
+
+
+async def test_queued_write_dropped_when_deadline_passes_in_queue():
+    """The drain must drop a queued unit whose caller deadline passed
+    while an earlier batch held the writer — resolved with
+    DeadlineExceeded (never executed, never hung), slot released."""
+    from nakama_tpu.storage.db import Database
+
+    db = Database(":memory:")
+    await db.connect()
+    await db.execute("CREATE TABLE kv (k TEXT PRIMARY KEY, v INT)")
+
+    real = db._run_write_group
+    slow_done = asyncio.Event()
+
+    async def slow_group(units):
+        await asyncio.sleep(0.15)  # the stalled drain, loop stays free
+        slow_done.set()
+        db._run_write_group = real
+        return await real(units)
+
+    db._run_write_group = slow_group
+    t_a = asyncio.create_task(
+        db.execute("INSERT INTO kv (k, v) VALUES ('a', 1)")
+    )
+    await asyncio.sleep(0.02)  # drain popped A, now stalled
+    with overload.deadline_scope(Deadline(0.05)):
+        t_b = asyncio.create_task(
+            db.execute("INSERT INTO kv (k, v) VALUES ('b', 2)")
+        )
+        await asyncio.sleep(0)
+    assert await asyncio.wait_for(t_a, 10) == 1
+    with pytest.raises(DeadlineExceeded):
+        await asyncio.wait_for(t_b, 10)
+    await db._batcher.flush()
+    assert db._batcher.depth == 0
+    assert db._batcher.units_expired == 1
+    rows = {r["k"] for r in await db.fetch_all("SELECT k FROM kv")}
+    assert rows == {"a"}  # B never executed
+    await db.close()
+
+
+# --------------------------------------------------- matchmaker deadline
+
+
+def test_matchmaker_add_rejects_expired_deadline():
+    from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+    from nakama_tpu.matchmaker.local import CpuBackend
+
+    mm = LocalMatchmaker(
+        quiet_logger(), MatchmakerConfig(backend="cpu"),
+        backend=CpuBackend(),
+    )
+    p = MatchmakerPresence(user_id="u1", session_id="s1")
+    with overload.deadline_scope(Deadline(0.0)):
+        with pytest.raises(DeadlineExceeded):
+            mm.add([p], "s1", "", "*", 2, 2, 1, {}, {})
+    assert len(mm) == 0
+    # Without a deadline the same add registers.
+    mm.add([p], "s1", "", "*", 2, 2, 1, {}, {})
+    assert len(mm) == 1
+
+
+# ---------------------------------------------------- pipeline admission
+
+
+class _StubSession:
+    def __init__(self):
+        self.id = "sess-1"
+        self.user_id = "user-1"
+        self.username = "u"
+        self.format = "json"
+        self.sent: list[dict] = []
+
+    def send(self, envelope):
+        self.sent.append(envelope)
+        return True
+
+
+async def test_pipeline_realtime_admission_rejects_with_error_envelope():
+    from nakama_tpu.api.pipeline import Components, Pipeline
+    from nakama_tpu.realtime import (
+        LocalMessageRouter,
+        LocalSessionRegistry,
+        LocalStatusRegistry,
+        LocalTracker,
+    )
+
+    log = quiet_logger()
+    config = Config()
+    tracker = LocalTracker(log, "test", None, 64)
+    sessions = LocalSessionRegistry(log, None)
+    router = LocalMessageRouter(log, sessions, tracker, None)
+    status = LocalStatusRegistry(log, sessions)
+    adm = AdmissionController(1, {REALTIME: 0, RPC: 0, LIST: 0})
+    ov = OverloadController(adm)
+    pipeline = Pipeline(
+        log,
+        Components(
+            config=config,
+            tracker=tracker,
+            router=router,
+            status_registry=status,
+            overload=ov,
+        ),
+    )
+    session = _StubSession()
+    # A free permit: the envelope processes normally.
+    assert await pipeline.process(session, {"ping": {}, "cid": "1"})
+    assert session.sent[-1] == {"pong": {}, "cid": "1"}
+    assert adm.inflight == 0
+    # Exhaust the only permit: the realtime queue (cap 0) rejects, and
+    # the client gets a retryable error envelope, not a dropped socket.
+    await adm.admit(REALTIME)
+    assert await pipeline.process(session, {"ping": {}, "cid": "2"})
+    out = session.sent[-1]
+    assert out["cid"] == "2" and "error" in out
+    assert "overloaded" in out["error"]["message"]
+    adm.release()
+
+
+# -------------------------------------------------- session_ws overflow
+
+
+async def test_session_ws_overflow_counts_and_bounded_close():
+    from nakama_tpu.api.session_ws import WebSocketSession
+
+    class _FakeWs:
+        def __init__(self):
+            self.closed = False
+
+        async def send(self, data):
+            pass
+
+        async def close(self, code=1000, reason=""):
+            self.closed = True
+
+    metrics = Metrics()
+    ws = _FakeWs()
+    session = WebSocketSession(
+        ws,
+        user_id="u",
+        username="u",
+        vars={},
+        format="json",
+        expiry=0,
+        logger=quiet_logger(),
+        outgoing_queue_size=2,
+        metrics=metrics,
+    )
+    assert session.send({"a": 1}) and session.send({"b": 2})
+    t0 = time.perf_counter()
+    assert not session.send({"c": 3})  # overflow: drop + close
+    assert not session.send({"d": 4})  # racing send: drop, ONE close
+    assert session.overflow_drops == 2
+    await asyncio.sleep(0.05)  # let the close task run
+    assert ws.closed
+    assert time.perf_counter() - t0 < 1.0  # deadline-bounded close
+    snap = metrics.snapshot()
+    assert snap[
+        "nakama_session_outgoing_overflow_total{kind=drop}"
+    ] == 2
+    assert snap[
+        "nakama_session_outgoing_overflow_total{kind=close}"
+    ] == 1
+
+
+# ------------------------------------------------------ http limit clamp
+
+
+def test_http_limit_clamp():
+    from nakama_tpu.api.http import ApiError, _limit
+
+    assert _limit({"limit": "50"}) == 50
+    assert _limit({}) == 100
+    assert _limit({}, default=10) == 10
+    assert _limit({"limit": "-5"}) == 1
+    assert _limit({"limit": "0"}) == 1
+    assert _limit({"limit": "99999"}) == 1000
+    with pytest.raises(ApiError) as ei:
+        _limit({"limit": "abc"})
+    assert ei.value.status == 400
+
+
+# ----------------------------------------------------- bench gate (named)
+
+
+def test_overload_regression_gate():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_overload_gate",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py",
+        ),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    gate = bench.overload_regression
+    # Healthy run: green.
+    reasons, bad = gate(40.0, 70.0, 0.2, 0, ladder_recovered=True)
+    assert not bad and reasons == []
+    # Each violation fires the gate with a named reason.
+    _, bad = gate(40.0, 90.0, 0.2, 0)
+    assert bad  # admitted p99 > 2x unloaded
+    _, bad = gate(40.0, 70.0, 6.0, 0)
+    assert bad  # rejections not fast
+    _, bad = gate(40.0, 70.0, 0.2, 3)
+    assert bad  # hung requests
+    _, bad = gate(40.0, 70.0, 0.2, 0, ladder_recovered=False)
+    assert bad  # ladder stuck in SHED
