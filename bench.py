@@ -1047,6 +1047,169 @@ def run_overload_main() -> int:
     return 1 if regression else 0
 
 
+# --------------------------------------------------------- trace overhead
+
+# Denominator for the disarmed-tracing overhead gate: the measured
+# 100k-ticket interval headline (BENCH_r05 matchmaker_process_p99_ms_100k
+# = 20.9ms). Deliberately the BEST measured interval, so the gate is
+# conservative — overhead as a fraction of a slower interval only
+# shrinks.
+TRACE_INTERVAL_BUDGET_MS = float(
+    os.environ.get("BENCH_TRACE_BUDGET_MS", 20.9)
+)
+
+
+def trace_overhead_regression(overhead_pct) -> tuple[list, bool]:
+    """The tracing gate (named + tier-1-unit-tested like PR 4's
+    cadence_regression and PR 5's overload_regression, so it cannot
+    silently rot): the DISARMED/sampled-out tracing plane — no ambient
+    trace on the caller, default 1% sampling, i.e. the bench and
+    production interval posture — must cost under 1% of the 100k-ticket
+    interval budget. Returns (reasons, regression)."""
+    reasons = []
+    if overhead_pct >= 1.0:
+        reasons.append(
+            f"disarmed_trace_overhead {overhead_pct:.4f}% >= 1% of a"
+            f" {TRACE_INTERVAL_BUDGET_MS}ms interval"
+        )
+    return reasons, bool(reasons)
+
+
+def _measure_trace_costs() -> dict:
+    """Per-call cost of every tracing hook the 100k interval path pays,
+    measured hot with the store at the production posture (enabled, 1%
+    sampling — so finalize/drop work is included)."""
+    from nakama_tpu import tracing as trace_api
+
+    trace_api.TRACES.reset()
+    trace_api.TRACES.configure(enabled=True, sample_rate=0.01)
+
+    out = {}
+    # The guard every instrumentation point pays when no trace is
+    # active (matchmaker add, db submit, breaker events, log lines).
+    n = 1_000_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        trace_api.current_span()
+    out["guard_ns"] = (time.perf_counter() - t0) / n * 1e9
+
+    # A disarmed child span (span() with no parent): the fast-path
+    # no-op of db.write / admission / pipeline spans.
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace_api.span("x"):
+            pass
+    out["noop_span_us"] = (time.perf_counter() - t0) / n * 1e6
+
+    # The FULL per-interval cohort trace cycle exactly as tpu.py pays
+    # it: root span at dispatch, hold, three post-hoc stage spans at
+    # accept, release → tail-sampling finalize (99% dropped).
+    n = 20_000
+    base = time.time()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace_api.root_span("matchmaker.cohort", actives=100_000) as r:
+            trace_api.TRACES.hold(r.trace_id)
+            tctx = (r.trace_id, r.span_id)
+        for name in ("cohort.ready", "cohort.fetched", "cohort.collected"):
+            trace_api.emit_span(
+                tctx[0], tctx[1], name, start_ts=base, end_ts=base
+            )
+        trace_api.TRACES.release(tctx[0])
+    out["cohort_cycle_us"] = (time.perf_counter() - t0) / n * 1e6
+
+    # One ledger append (record_delivery and friends).
+    from nakama_tpu.tracing import Ledger
+
+    led = Ledger(256)
+    n = 500_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        led.append({"x": 1})
+    out["ledger_append_us"] = (time.perf_counter() - t0) / n * 1e6
+    trace_api.TRACES.reset()
+    return out
+
+
+def run_trace_overhead_main() -> int:
+    """`bench.py --trace-overhead`: the tracing-plane overhead proof.
+    Measures the disarmed/sampled-out per-call costs hot, composes them
+    into the per-interval total the 100k-ticket path actually pays (one
+    cohort trace cycle + the contextvar guards + ledger appends — ticket
+    spans and db links are guarded to zero when no traced requests
+    exist), and gates it <1% of the interval budget via the named,
+    tier-1-unit-tested `trace_overhead_regression`. Verdict rides the
+    single `bench_all_metrics` tail line and the exit code."""
+    all_metrics: dict[str, dict] = {}
+
+    def emit_json(obj):
+        print(json.dumps(obj), flush=True)
+        all_metrics[obj["metric"]] = obj
+
+    costs = _measure_trace_costs()
+    # Per-interval composition on the 100k path (process → dispatch →
+    # accept → publish): ONE cohort trace cycle, ~8 guarded
+    # instrumentation points reading the contextvar (_finish_ticket_
+    # traces, _stamp_published/SLO, record_breaker, db hooks on the
+    # gap drain), ~4 no-op child spans (db.write on gap-work writes),
+    # and ~4 ledger appends (delivery + breadcrumb + drains).
+    per_interval_us = (
+        costs["cohort_cycle_us"]
+        + 8 * costs["guard_ns"] / 1000.0
+        + 4 * costs["noop_span_us"]
+        + 4 * costs["ledger_append_us"]
+    )
+    overhead_pct = (
+        per_interval_us / (TRACE_INTERVAL_BUDGET_MS * 1000.0) * 100.0
+    )
+    reasons, regression = trace_overhead_regression(overhead_pct)
+
+    emit_json(
+        {
+            "metric": "trace_disarmed_costs",
+            "value": round(per_interval_us, 3),
+            "unit": "us per 100k-ticket interval",
+            **{k: round(v, 4) for k, v in costs.items()},
+        }
+    )
+    emit_json(
+        {
+            "metric": "trace_overhead_pct",
+            "value": round(overhead_pct, 5),
+            "unit": f"% of a {TRACE_INTERVAL_BUDGET_MS}ms interval",
+            "note": (
+                "disarmed/sampled-out tracing on the 100k-ticket"
+                " interval path: cohort trace cycle + contextvar guards"
+                " + ledger appends; per-ticket spans are guarded to"
+                " zero without traced requests"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "trace_overhead_regression",
+            "value": int(regression),
+            "unit": "bool",
+            "regression": regression,
+            "reasons": reasons,
+        }
+    )
+    print(
+        json.dumps(
+            {"metric": "bench_all_metrics", "metrics": all_metrics}
+        ),
+        flush=True,
+    )
+    if regression:
+        print(
+            f"FAIL: trace overhead regression: {'; '.join(reasons)}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return 1 if regression else 0
+
+
 # ------------------------------------------------------------------ chaos
 
 CHAOS_POOL = int(os.environ.get("BENCH_CHAOS_POOL", 1024))
@@ -1452,6 +1615,13 @@ def main():
         # writes its verdict into the same single bench_all_metrics
         # tail line a driver keeps.
         return run_overload_main()
+    if "--trace-overhead" in sys.argv[1:] or os.environ.get(
+        "BENCH_TRACE_OVERHEAD"
+    ):
+        # Tracing-only run: the disarmed/sampled-out tracing overhead
+        # proof on the 100k interval path, gated <1% by the named
+        # trace_overhead_regression.
+        return run_trace_overhead_main()
 
     device = jax.devices()[0].platform
     rng = np.random.default_rng(42)

@@ -322,9 +322,29 @@ class GrpcGateway:
                 remaining = context.time_remaining()
                 if remaining is not None:
                     timeout = f"{max(1, int(remaining * 1000))}m"
+            # Trace propagation: the client's W3C traceparent rides the
+            # loopback call as an HTTP header, so the REST middleware —
+            # the single tracing enforcement point — continues the
+            # caller's trace; the response's traceparent comes back as
+            # trailing metadata.
+            traceparent = meta.get("traceparent", "")
             try:
-                return await self._call(spec, request, auth, timeout)
+                msg, resp_tp = await self._call(
+                    spec, request, auth, timeout, traceparent
+                )
+                if resp_tp:
+                    context.set_trailing_metadata(
+                        (("traceparent", resp_tp),)
+                    )
+                return msg
             except _ApiStatusError as e:
+                if e.traceparent:
+                    # Error responses carry their traceparent too —
+                    # 429/504 traces are exactly the tail-kept ones a
+                    # caller needs to correlate.
+                    context.set_trailing_metadata(
+                        (("traceparent", e.traceparent),)
+                    )
                 await context.abort(e.code, e.message)
             except Exception as e:  # transcode/transport failure
                 self.logger.error(
@@ -339,7 +359,12 @@ class GrpcGateway:
         )
 
     async def _call(
-        self, spec: RouteSpec, request, auth: str, timeout: str = ""
+        self,
+        spec: RouteSpec,
+        request,
+        auth: str,
+        timeout: str = "",
+        traceparent: str = "",
     ):
         body = json_format.MessageToDict(
             request, preserving_proto_field_name=True
@@ -373,6 +398,8 @@ class GrpcGateway:
             headers["Authorization"] = auth
         if timeout:
             headers["grpc-timeout"] = timeout
+        if traceparent:
+            headers["traceparent"] = traceparent
         async with self._http.request(
             spec.verb,
             self._base + path,
@@ -381,6 +408,7 @@ class GrpcGateway:
             data=data,
             headers=headers,
         ) as resp:
+            resp_tp = resp.headers.get("traceparent", "")
             try:
                 payload = await resp.json(content_type=None)
             except ValueError:
@@ -403,9 +431,12 @@ class GrpcGateway:
                         504: grpc.StatusCode.DEADLINE_EXCEEDED,
                     }.get(resp.status, grpc.StatusCode.INTERNAL)
                     message = f"HTTP {resp.status}"
-                raise _ApiStatusError(code, message)
-        return json_format.ParseDict(
-            payload or {}, spec.response(), ignore_unknown_fields=True
+                raise _ApiStatusError(code, message, traceparent=resp_tp)
+        return (
+            json_format.ParseDict(
+                payload or {}, spec.response(), ignore_unknown_fields=True
+            ),
+            resp_tp,
         )
 
     # ----------------------------------------------------------- lifecycle
@@ -442,9 +473,13 @@ class GrpcGateway:
 
 
 class _ApiStatusError(Exception):
-    """REST error carried to the handler, aborted with the mapped status."""
+    """REST error carried to the handler, aborted with the mapped status
+    (plus the response's traceparent, echoed as trailing metadata)."""
 
-    def __init__(self, code: grpc.StatusCode, message: str):
+    def __init__(
+        self, code: grpc.StatusCode, message: str, traceparent: str = ""
+    ):
         super().__init__(message)
         self.code = code
         self.message = message
+        self.traceparent = traceparent

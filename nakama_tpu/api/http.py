@@ -19,11 +19,13 @@ from __future__ import annotations
 import asyncio
 import base64
 import json
+import time
 from typing import Any
 
 from aiohttp import WSMsgType, web
 
 from .. import overload
+from .. import tracing as trace_api
 from ..core import account as core_account
 from ..core import authenticate as core_auth
 from ..core import link as core_link
@@ -253,16 +255,80 @@ class ApiServer:
 
     @web.middleware
     async def _overload_middleware(self, request: web.Request, handler):
-        """The overload triad at the front door (overload.py): deadline
-        from `grpc-timeout`/`X-Request-Timeout` (else the per-class
+        """The request-plane front door: one trace root span per
+        request (W3C `traceparent` ingested from the request and
+        emitted on every response — including 429/504 rejections, whose
+        traces are error-status and therefore always tail-kept), the
+        overload triad (overload.py) inside it, and the api-latency SLO
+        observation on the way out. /ws and health stay exempt from
+        both planes."""
+        if request.path in _OVERLOAD_EXEMPT:
+            return await handler(request)
+        ov = getattr(self.server, "overload", None)
+        if not trace_api.TRACES.enabled:
+            return await self._normalized(request, handler, ov)
+        t0 = time.perf_counter()
+        with trace_api.root_span(
+            f"http {request.method} {request.path}",
+            traceparent=request.headers.get("traceparent", ""),
+            **{"http.method": request.method, "http.path": request.path},
+        ) as root:
+            resp = await self._normalized(request, handler, ov)
+            status = getattr(resp, "status", 0)
+            if root is not None:
+                root.set_attribute("http.status", status)
+                if status in (429, 504) or status >= 500:
+                    # Tail-kept: shed/deadline/internal responses are
+                    # exactly the traces worth 100% retention.
+                    root.set_status("error", f"http {status}")
+                try:
+                    resp.headers["traceparent"] = (
+                        trace_api.format_traceparent(
+                            root.trace_id, root.span_id
+                        )
+                    )
+                except Exception:
+                    pass
+            slo = getattr(self.server, "slo", None)
+            if slo is not None:
+                slo.observe(
+                    "api_latency", (time.perf_counter() - t0) * 1000
+                )
+            return resp
+
+    async def _normalized(self, request: web.Request, handler, ov):
+        """Every request resolves to a RESPONSE here — independent of
+        the tracing toggle, so the error envelope never changes shape
+        with an observability knob. Router-level statuses (404/405)
+        raised as HTTPException become their response (judged by status
+        upstream, not blanket-marked error — a URL scanner must not
+        evict genuine error traces from the bounded kept ring); a raw
+        escape (handlers map their own errors, so this is an unexpected
+        bug path) becomes the API's standard JSON 500, so the outage
+        still gets its error trace, traceparent echo, SLO observation,
+        and a trace-correlated log line."""
+        try:
+            return await self._admitted(request, handler, ov)
+        except web.HTTPException as e:
+            return e
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error(
+                "unhandled error in request middleware", error=str(e)
+            )
+            return _error_response("internal error", 500, GRPC_INTERNAL)
+
+    async def _admitted(self, request: web.Request, handler, ov):
+        """The overload triad (overload.py): deadline from
+        `grpc-timeout`/`X-Request-Timeout` (else the per-class
         default), token-bucket rate limit, prioritized admission, and
         the deadline carried via contextvar into storage/matchmaker
         checkpoints. GET = list/read class; everything else =
         authenticated-RPC class (realtime envelopes are classed in the
         pipeline). The disarmed cost is one deadline object, one
         contextvar set/reset, and the admission fast path."""
-        ov = getattr(self.server, "overload", None)
-        if ov is None or request.path in _OVERLOAD_EXEMPT:
+        if ov is None:
             return await handler(request)
         # Class before auth runs (auth lives in the handlers), so the
         # credential HEADER is the classifier: a request presenting no
@@ -299,7 +365,10 @@ class ApiServer:
                 headers={"Retry-After": str(int(e.retry_after_sec))},
             )
         try:
-            await ov.admission.admit(cls, deadline)
+            with trace_api.span(
+                "admission", **{"class": overload.CLASS_NAMES[cls]}
+            ):
+                await ov.admission.admit(cls, deadline)
         except overload.AdmissionRejected as e:
             return _error_response(
                 str(e), 429, GRPC_RESOURCE_EXHAUSTED,

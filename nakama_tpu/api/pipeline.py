@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from .. import overload
+from .. import tracing as trace_api
 from ..logger import Logger
 from ..match.party import PartyError
 from ..metrics import Metrics
@@ -71,13 +72,31 @@ class Pipeline:
     # ------------------------------------------------------------ dispatch
 
     async def process(self, session, envelope: dict) -> bool:
-        """Entry from the socket read loop: realtime-class admission +
-        a per-envelope deadline (overload.py), then dispatch. Socket
-        ops are the HIGHEST priority class — under load the admission
-        controller sheds anonymous reads and queues RPCs before a
-        single realtime envelope waits — but they are still bounded:
-        past the realtime queue cap the envelope is answered with a
-        retryable error instead of queueing without limit."""
+        """Entry from the socket read loop: one trace root span per
+        envelope (the socket has no traceparent header, so every
+        envelope starts a fresh trace carrying session identity), then
+        realtime-class admission + a per-envelope deadline
+        (overload.py), then dispatch."""
+        if not trace_api.TRACES.enabled:
+            return await self._process_admitted(session, envelope, None)
+        key = (
+            message_key(envelope) if isinstance(envelope, dict) else None
+        )
+        with trace_api.root_span(
+            f"ws.{key or 'envelope'}",
+            session_id=getattr(session, "id", ""),
+            user_id=getattr(session, "user_id", ""),
+        ) as root:
+            return await self._process_admitted(session, envelope, root)
+
+    async def _process_admitted(self, session, envelope: dict, root) -> bool:
+        """Realtime-class admission + a per-envelope deadline
+        (overload.py), then dispatch. Socket ops are the HIGHEST
+        priority class — under load the admission controller sheds
+        anonymous reads and queues RPCs before a single realtime
+        envelope waits — but they are still bounded: past the realtime
+        queue cap the envelope is answered with a retryable error
+        instead of queueing without limit."""
         ov = self.c.overload
         if ov is None:
             return await self._dispatch(session, envelope)
@@ -90,8 +109,11 @@ class Pipeline:
         )
         deadline = overload.Deadline(max(1, default_ms) / 1000.0)
         try:
-            await ov.admission.admit(overload.REALTIME, deadline)
+            with trace_api.span("admission", **{"class": "realtime"}):
+                await ov.admission.admit(overload.REALTIME, deadline)
         except overload.AdmissionRejected:
+            if root is not None:
+                root.set_status("error", "admission rejected")
             session.send(
                 error(
                     ErrorCode.RUNTIME_EXCEPTION,
@@ -102,6 +124,8 @@ class Pipeline:
             return True
         except overload.DeadlineExceeded:
             self._note_deadline()
+            if root is not None:
+                root.set_status("error", "deadline exceeded")
             session.send(
                 error(ErrorCode.RUNTIME_EXCEPTION, "deadline exceeded", cid)
             )
@@ -170,7 +194,8 @@ class Pipeline:
                     return True
 
         try:
-            await _maybe_await(handler(session, cid, body))
+            with trace_api.span(f"pipeline.{key}"):
+                await _maybe_await(handler(session, cid, body))
         except PipelineError as e:
             session.send(error(e.code, str(e), cid))
         except overload.DeadlineExceeded as e:
@@ -178,9 +203,15 @@ class Pipeline:
             # on this envelope's deadline: a retryable error, not an
             # internal one.
             self._note_deadline()
+            sp = trace_api.current_span()
+            if sp is not None:
+                sp.set_status("error", f"deadline exceeded: {e}")
             session.send(error(ErrorCode.RUNTIME_EXCEPTION, str(e), cid))
         except Exception as e:
             self.logger.error("pipeline handler error", key=key, error=str(e))
+            sp = trace_api.current_span()
+            if sp is not None:
+                sp.set_status("error", f"{type(e).__name__}: {e}")
             session.send(error(ErrorCode.RUNTIME_EXCEPTION, "internal error", cid))
             return True
 

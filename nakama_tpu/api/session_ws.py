@@ -15,6 +15,7 @@ import asyncio
 import uuid
 from typing import Any, Callable
 
+from .. import tracing as trace_api
 from ..logger import Logger
 from . import protocol
 
@@ -86,6 +87,17 @@ class WebSocketSession:
             return True
         except asyncio.QueueFull:
             self.overflow_drops += 1
+            # When the drop happens inside a traced envelope (a chat
+            # send or relayed match-data fan-out runs in the SENDER's
+            # envelope span; matchmaker-task publishes carry no span
+            # and no-op here), the trace records WHICH session
+            # swallowed the message — log lines alone can't join that
+            # back to the request.
+            trace_api.add_event(
+                "session.overflow_drop",
+                session_id=self._id,
+                dropped=self.overflow_drops,
+            )
             self._note_overflow("drop")
             if self._overflow_closing:
                 return False  # close already scheduled; just count

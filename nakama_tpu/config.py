@@ -323,6 +323,47 @@ class OverloadConfig:
 
 
 @dataclass
+class TracingConfig:
+    """Request-scoped tracing + SLO plane (tracing.py): W3C traceparent
+    in/out at the front doors, span trees across admission → pipeline →
+    matchmaker/storage, tail-based sampling into the bounded in-process
+    trace store (`/v2/console/traces`), and the 5m/1h SLO burn-rate
+    recorder. Defaults are the disarmed production posture: tracing on,
+    1% p-sample, errors/slow traces kept 100%."""
+
+    enabled: bool = True
+    # Probability a non-error, non-slow trace is kept (deterministic by
+    # trace id). Error/429/504/deadline-exceeded traces and traces
+    # slower than slow_trace_ms are ALWAYS kept (tail-based sampling).
+    # "Slow" is judged on the full span extent — a held add→matched
+    # trace spans its cohort's delivery, so at a 15s interval cadence
+    # matched-ticket traces typically exceed 1s and are slow-kept;
+    # raise slow_trace_ms above interval_sec*1000 to p-sample them.
+    sample_rate: float = 0.01
+    slow_trace_ms: int = 1000
+    # Bounded stores: kept traces, in-flight trace buffer, spans/trace.
+    capacity: int = 256
+    max_active_traces: int = 512
+    max_spans_per_trace: int = 64
+    # Optional JSONL export: one kept trace per line, appended.
+    export_path: str = ""
+    # SLO plane: target good-fraction + per-SLI thresholds. Burn rate =
+    # bad_fraction / (1 - target) over 5m and 1h windows, published as
+    # slo_burn_rate{slo,window}.
+    slo_target: float = 0.99
+    slo_api_latency_ms: int = 200
+    slo_interval_ms: int = 1000  # matchmaker process() wall time
+    slo_publish_lag_ms: int = 5000  # cohort dispatch→published lag
+    # Feed the 5m burn rate into the OverloadController ladder (WARN at
+    # slo_burn_warn, SHED at slo_burn_shed). Off by default: first
+    # intervals pay multi-second XLA compiles that would spike the burn
+    # and tighten admission on a freshly-booted server.
+    slo_overload_feedback: bool = False
+    slo_burn_warn: float = 14.0
+    slo_burn_shed: float = 100.0
+
+
+@dataclass
 class SocialConfig:
     steam_app_id: int = 0
     steam_publisher_key: str = ""
@@ -350,6 +391,7 @@ class Config:
     social: SocialConfig = field(default_factory=SocialConfig)
     satori: SatoriConfig = field(default_factory=SatoriConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
 
     @property
     def node(self) -> str:
@@ -384,6 +426,12 @@ class Config:
                 "overload.shed_queue_depth_warn should be in"
                 " (0, shed_queue_depth_shed]"
             )
+        if not (0.0 <= self.tracing.sample_rate <= 1.0):
+            warnings.append(
+                "tracing.sample_rate should be in [0, 1]"
+            )
+        if not (0.0 < self.tracing.slo_target < 1.0):
+            warnings.append("tracing.slo_target should be in (0, 1)")
         return warnings
 
 
@@ -566,6 +614,7 @@ __all__ = [
     "IAPConfig",
     "SocialConfig",
     "OverloadConfig",
+    "TracingConfig",
     "load_config",
     "parse_args",
     "config_to_dict",

@@ -58,6 +58,8 @@ class ConsoleServer:
         r.add_post("/v2/console/authenticate", self._h_authenticate)
         r.add_get("/v2/console/status", self._h_status)
         r.add_get("/v2/console/overload", self._h_overload)
+        r.add_get("/v2/console/traces", self._h_traces)
+        r.add_get("/v2/console/traces/{trace_id}", self._h_trace_get)
         r.add_get("/v2/console/config", self._h_config)
         r.add_get("/v2/console/runtime", self._h_runtime)
         r.add_get("/", self._h_ui)
@@ -311,6 +313,11 @@ class ConsoleServer:
                     if getattr(s, "overload", None) is not None
                     else "disabled"
                 ),
+                "slo_burn_rates": (
+                    s.slo.sample()
+                    if getattr(s, "slo", None) is not None
+                    else {}
+                ),
                 "config_warnings": self.config.check(),
             }
         )
@@ -337,6 +344,41 @@ class ConsoleServer:
                 ),
             }
         )
+
+    async def _h_traces(self, request: web.Request):
+        """Kept-trace browser: newest-first summaries from the
+        tail-sampled in-process store, plus the sampling posture and
+        SLO burn snapshot — the operator's "why was this add→matched
+        3s" entry point; a single trace id drills in below."""
+        self._auth(request)
+        from ..tracing import TRACES
+
+        raw = request.query.get("n", 32)
+        try:
+            n = min(256, max(1, int(raw)))
+        except (TypeError, ValueError):
+            # Same contract as the API's _limit clamp: a non-numeric
+            # param is the client's 400, never our 500.
+            return _err(400, f"n must be an integer, got {raw!r}")
+        slo = getattr(self.server, "slo", None)
+        return web.json_response(
+            {
+                "traces": TRACES.list(n),
+                **TRACES.stats(),
+                "slo": slo.snapshot() if slo is not None else {},
+            }
+        )
+
+    async def _h_trace_get(self, request: web.Request):
+        """One kept trace in the OTLP-ish shape (resourceSpans →
+        scopeSpans → spans, attributes flattened)."""
+        self._auth(request)
+        from ..tracing import TRACES
+
+        trace = TRACES.get(request.match_info["trace_id"])
+        if trace is None:
+            return _err(404, "trace not found (dropped or evicted)")
+        return web.json_response(trace)
 
     async def _h_config(self, request: web.Request):
         """Config tree with secret redaction (reference
@@ -725,6 +767,12 @@ class ConsoleServer:
                     tracing.delivery_stage_stats()
                     if tracing is not None
                     and hasattr(tracing, "delivery_stage_stats")
+                    else {}
+                ),
+                "ledger_totals": (
+                    tracing.ledger_totals()
+                    if tracing is not None
+                    and hasattr(tracing, "ledger_totals")
                     else {}
                 ),
             }

@@ -63,6 +63,8 @@ const R = {
   logout:           ['POST',   '/v2/console/authenticate/logout'],
   status:           ['GET',    '/v2/console/status'],
   overload:         ['GET',    '/v2/console/overload'],
+  traces:           ['GET',    '/v2/console/traces'],
+  traceGet:         ['GET',    '/v2/console/traces/{trace_id}'],
   config:           ['GET',    '/v2/console/config'],
   runtime:          ['GET',    '/v2/console/runtime'],
   accountList:      ['GET',    '/v2/console/account'],
@@ -541,6 +543,32 @@ const TABS = {
   matchmaker: async (el) => {
     const d = await call('matchmaker');
     el.appendChild($(jpre(d)));
+  },
+  traces: async (el) => {
+    // Tail-sampled request traces: summary table → one-click span
+    // drill-down (OTLP-ish body rendered verbatim).
+    const d = await call('traces', {}, undefined, { n: 100 });
+    const rows = (d.traces || []).map(t =>
+      `<tr><td><a href="#" data-id="${esc(t.trace_id)}">` +
+      `${esc(t.trace_id)}</a></td><td>${esc(t.root)}</td>` +
+      `<td>${esc(t.duration_ms)}</td><td>${esc(t.status)}</td>` +
+      `<td>${esc(t.reason)}</td><td>${esc(t.n_spans)}</td></tr>`)
+      .join('');
+    el.appendChild($(`<h4>sampling</h4>${jpre({
+      sample_rate: d.sample_rate, slow_ms: d.slow_ms,
+      finished_total: d.finished_total, kept_total: d.kept_total,
+      kept_by: d.kept_by })}
+      <h4>slo burn rates</h4>${jpre(d.slo || {})}
+      <h4>kept traces</h4>
+      <table><tr><th>trace</th><th>root</th><th>ms</th><th>status</th>
+      <th>reason</th><th>spans</th></tr>${rows}</table>
+      <div id="det"></div>`));
+    el.querySelectorAll('a[data-id]').forEach(a => a.onclick =
+      async (e) => {
+        e.preventDefault();
+        const one = await call('traceGet', { trace_id: a.dataset.id });
+        el.querySelector('#det').innerHTML = jpre(one);
+      });
   },
   leaderboards: async (el) => {
     const d = await call('lbList');

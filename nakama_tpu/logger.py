@@ -23,6 +23,7 @@ import time
 from typing import Any, TextIO
 
 from .config import LoggerConfig
+from .tracing import current_trace_ids
 
 _LOGFMT_BARE = re.compile(r"^[A-Za-z0-9_.\-/@:+]*$")
 
@@ -240,6 +241,14 @@ class Logger:
             **self._fields,
             **kv,
         }
+        # Logs↔traces correlation: a line emitted inside an active
+        # trace carries its ids, so `grep trace_id` joins the log
+        # stream to /v2/console/traces. One contextvar read per line;
+        # explicit kv keys win over the ambient context.
+        ids = current_trace_ids()
+        if ids is not None:
+            record.setdefault("trace_id", ids[0])
+            record.setdefault("span_id", ids[1])
         if self._fmt == "json":
             line = json.dumps(record, default=str)
         elif self._fmt == "logfmt":

@@ -31,6 +31,7 @@ from typing import Callable, Protocol
 import numpy as np
 
 from .. import faults, overload
+from .. import tracing as trace_api
 from ..config import MatchmakerConfig
 from ..logger import Logger
 from ..metrics import Metrics
@@ -254,6 +255,18 @@ class LocalMatchmaker:
 
         self._paused = False
         self._stopped = False
+        # Request-scoped tracing: tickets added inside an active trace
+        # hold that trace open (tail sampling defers until the ticket
+        # resolves) so the cohort's dispatch→ready→collected→published
+        # stages land in the SAME trace as the socket envelope that
+        # created the ticket. Values carry the ticket's SLOT so the
+        # interval sweep is O(held tickets), never O(matched slots).
+        # Bounded: oldest holds release at the cap; expiry releases on
+        # deactivation (a later passive match is not appended).
+        self._ticket_traces: dict[str, tuple[str, str, int]] = {}
+        # SLO plane (tracing.SloRecorder, bound by the server): interval
+        # wall time and publish lag observations feed the burn gauges.
+        self.slo = None
         self._task: asyncio.Task | None = None
         # Event-driven delivery stage (start() spawns it alongside the
         # interval task): cohort worker threads set this event via
@@ -634,7 +647,98 @@ class LocalMatchmaker:
             embedding=embedding,
         )
         self._register(ticket)
+        sp = trace_api.current_span()
+        if sp is not None:
+            slot = self.store.slot_by_id(ticket_id)
+            # The add as a real span in the caller's trace, plus a hold
+            # so the trace stays open until the ticket matches (or is
+            # removed) — the add→matched story reads off one trace id.
+            trace_api.emit_span(
+                sp.trace_id, sp.span_id, "matchmaker.add",
+                start_ts=created_at, end_ts=time.time(),
+                ticket=ticket_id, query=query,
+                min_count=min_count, max_count=max_count,
+            )
+            if slot is not None:
+                self._hold_ticket_trace(ticket_id, sp, slot)
+            self.logger.debug(
+                "matchmaker ticket added", ticket=ticket_id
+            )
         return ticket_id, created_at
+
+    def _hold_ticket_trace(self, ticket_id: str, sp, slot: int) -> None:
+        trace_api.TRACES.hold(sp.trace_id)
+        self._ticket_traces[ticket_id] = (sp.trace_id, sp.span_id, slot)
+        while len(self._ticket_traces) > 4096:
+            # Bounded holds: a flood of traced adds that never resolve
+            # must not pin traces forever — oldest release unfinished.
+            old_id = next(iter(self._ticket_traces))
+            old_trace = self._ticket_traces.pop(old_id)[0]
+            trace_api.TRACES.release(old_trace)
+
+    def _release_ticket_trace(self, ticket_id: str) -> None:
+        ctx = self._ticket_traces.pop(ticket_id, None)
+        if ctx is not None:
+            trace_api.TRACES.release(ctx[0])
+
+    def _finish_ticket_traces(self, matched_slots, tracing) -> None:
+        """Resolve held ticket traces after an interval/collect pass:
+        matched tickets get the cohort stage spans (attributed to THEIR
+        cohort's ledger entry via backend._accepted_cohorts) and their
+        hold released; tickets parked inactive with no cohort in flight
+        (expired unmatched) release too — their trace completes with
+        just the add, and a later PASSIVE match is not appended (the
+        bounded store cannot hold traces for tickets that may linger
+        pooled indefinitely). O(held tickets) python plus O(matched)
+        numpy mask writes; O(1) when no traced tickets exist (the
+        bench path pays one dict bool check)."""
+        if not self._ticket_traces:
+            return
+        cap = len(self.store.ticket_at)
+        matched_mask = np.zeros(cap, dtype=bool)
+        if matched_slots is not None and len(matched_slots):
+            matched_mask[matched_slots] = True
+        # slot → accepted-cohort index (numpy fancy-assign, C speed):
+        # when one collect accepted SEVERAL cohorts, each matched slot
+        # maps to ITS cohort's ledger entry — a ticket must not wear
+        # another cohort's stage chain.
+        cohorts = list(getattr(self.backend, "_accepted_cohorts", ()))
+        cohort_of = None
+        if cohorts:
+            cohort_of = np.full(cap, -1, dtype=np.int32)
+            for i, (_, slots_arr) in enumerate(cohorts):
+                cohort_of[slots_arr] = i
+        default_entry = None
+        if tracing is not None and len(tracing.deliveries):
+            default_entry = tracing.deliveries[-1]
+        ticket_at = self.store.ticket_at
+        active = self.store.active
+        inflight = getattr(self.backend, "_in_flight_mask", None)
+        for tid, (trace_id, span_id, slot) in list(
+            self._ticket_traces.items()
+        ):
+            t = ticket_at[slot]
+            if t is None or t.ticket != tid:
+                # Slot already drained/reassigned under this entry (a
+                # path that bypassed the release hooks): close it out
+                # rather than pin the trace forever.
+                del self._ticket_traces[tid]
+                trace_api.TRACES.release(trace_id)
+                continue
+            if matched_mask[slot]:
+                del self._ticket_traces[tid]
+                entry = default_entry
+                if cohort_of is not None and cohort_of[slot] >= 0:
+                    entry = cohorts[cohort_of[slot]][0]
+                trace_api.emit_matched_spans((trace_id, span_id), entry)
+            elif not active[slot] and (
+                inflight is None or not inflight[slot]
+            ):
+                # Deactivated (expired / min==max attempt spent) with
+                # no dispatched cohort that could still match it: the
+                # add→(not yet matched) trace finalizes now.
+                del self._ticket_traces[tid]
+                trace_api.TRACES.release(trace_id)
 
     def _register(self, ticket: MatchmakerTicket, active: bool = True):
         slot = self.store.add(ticket, active=active)
@@ -699,6 +803,7 @@ class LocalMatchmaker:
         if len(batch) and self.on_matched is not None:
             self._publish(batch)
             self._stamp_published(tracing, n_ledger)
+        self._finish_ticket_traces(matched_slots, tracing)
         return batch
 
     def _stamp_published(self, tracing, n_before: int):
@@ -721,6 +826,9 @@ class LocalMatchmaker:
         if self.metrics is not None:
             for lag in lags:
                 self.metrics.mm_delivery_publish_lag.observe(lag)
+        if self.slo is not None:
+            for lag in lags:
+                self.slo.observe("delivery_publish", lag * 1000)
 
     def _publish(self, batch: MatchBatch):
         """Deliver a matched batch to `on_matched`, bounded by the fault
@@ -828,10 +936,15 @@ class LocalMatchmaker:
             self.metrics.mm_process_time.observe(time.perf_counter() - t0)
             self.metrics.mm_matched.inc(batch.entry_count if batch else 0)
             self._update_gauges()
+        if self.slo is not None:
+            self.slo.observe(
+                "matchmaker_interval", (time.perf_counter() - t0) * 1000
+            )
 
         if len(batch) and self.on_matched is not None:
             self._publish(batch)
             self._stamp_published(_tracing, _n_ledger)
+        self._finish_ticket_traces(matched_slots, _tracing)
         # Attribute the post-backend tail (slot removal, delivery
         # callback) on the interval's breadcrumb: the p99 work that
         # isn't inside process_slots must still be visible to the bench
@@ -900,6 +1013,14 @@ class LocalMatchmaker:
         # API callers may pass duplicate ids; the store requires unique
         # slots (a duplicate would double-free into the allocator).
         slots = np.unique(np.asarray(slots, dtype=np.int32))
+        if self._ticket_traces:
+            # Cancelled/removed tickets release their trace holds (no
+            # matched spans — the trace finalizes with just the add).
+            ticket_at = self.store.ticket_at
+            for s in slots:
+                t = ticket_at[s]
+                if t is not None:
+                    self._release_ticket_trace(t.ticket)
         self.backend.on_remove_slots(slots)
         # Eager teardown: API removals are small, and immediate slot free
         # keeps LIFO reuse (pool density). Only the interval's bulk

@@ -36,6 +36,7 @@ from ..config import MatchmakerConfig
 from ..logger import Logger
 from ..metrics import Metrics
 from .. import faults, native
+from .. import tracing as trace_api
 from ..faults import CLOSED, HALF_OPEN, STATE_CODE, CircuitBreaker, classify_exception
 from .compile import (
     FULL_HI,
@@ -297,6 +298,12 @@ class TpuBackend:
         # holder's address for the next cohort's, which would make a
         # new head look already-guard-joined.
         self._dispatch_counter = 0
+        # Cohorts accepted by the CURRENT process/collect call:
+        # (ledger entry, matched slot array) pairs, so the ticket-trace
+        # closer attributes each matched ticket to ITS cohort's stage
+        # chain when one call collects several cohorts. Transient —
+        # replaced every call, never retained past it.
+        self._accepted_cohorts: list[tuple[dict, np.ndarray]] = []
 
     def attach(self, store):
         """Bind the LocalMatchmaker's SlotStore: one slot space shared by
@@ -516,6 +523,19 @@ class TpuBackend:
         kind = classify_exception(exc)
         if probe or self.breaker.state != HALF_OPEN:
             self.breaker.record_failure(fatal=(kind == "fatal"))
+        # The failure (and the breaker state it drove — read AFTER
+        # record_failure so the transition-causing failure reports the
+        # post-transition state, matching the log line) lands on the
+        # active trace span too: an injected `device.dispatch` fault
+        # yields a tail-kept error trace carrying its breaker event
+        # inline, not just a metrics bump to correlate by timestamp.
+        trace_api.add_event(
+            "breaker",
+            stage=stage,
+            kind=kind,
+            error=str(exc),
+            state=self.breaker.state,
+        )
         key = f"{stage}_failed"
         crumb[key] = crumb.get(key, 0) + 1
         if self.metrics is not None:
@@ -582,6 +602,11 @@ class TpuBackend:
                 # wedge as the probe's failure, or the breaker waits
                 # half-open forever for an answer that can never come.
                 self.breaker.record_failure()
+            self._close_cohort_trace(
+                head[0][1], status="error",
+                message=f"wedged cohort abandoned {round(now - dl, 1)}s"
+                " past deadline",
+            )
             self.logger.warn(
                 "abandoned wedged pipelined cohort",
                 overdue_s=round(now - dl, 1),
@@ -627,6 +652,7 @@ class TpuBackend:
         round 2 and was the north-star latency floor."""
         meta = self.meta
         pipelined = self.config.interval_pipelining
+        self._accepted_cohorts = []
         # Backstop reclamation first: wedged/orphaned in-flight claims
         # must release BEFORE this interval filters its dispatch by the
         # in-flight mask, or a stranded slot stays invisible forever.
@@ -723,49 +749,68 @@ class TpuBackend:
                 device_slots, device_last
             )
             pending = None
-            try:
-                with span(crumb, "flush_s"):
-                    self.pool.flush()
-                with span(crumb, "dispatch_s"):
-                    pending = self._dispatch(
-                        device_slots, device_last, rev_precision
+            # Each dispatched cohort gets its own trace: root span over
+            # flush+dispatch, held open until accept/abandon closes it
+            # with the stage spans. A dispatch failure makes it an
+            # error trace (tail-kept) carrying the breaker event.
+            with trace_api.root_span(
+                "matchmaker.cohort", actives=int(len(device_slots))
+            ) as troot:
+                try:
+                    with span(crumb, "flush_s"):
+                        self.pool.flush()
+                    with span(crumb, "dispatch_s"):
+                        pending = self._dispatch(
+                            device_slots, device_last, rev_precision
+                        )
+                except Exception as e:
+                    # A dispatch that dies — whether before or after any
+                    # partial bookkeeping — must strand nothing: no in-flight
+                    # claim survives (none was taken yet: claims are only
+                    # written below, after _dispatch returned), no cohort is
+                    # queued, and the slots stay matchable next interval (the
+                    # caller's expiry pass already deactivated min==max
+                    # actives, so they re-activate via react_parts).
+                    if troot is not None:
+                        troot.set_status(
+                            "error", f"{type(e).__name__}: {e}"
+                        )
+                    self._note_backend_failure("dispatch", e, crumb)
+                    react_parts.append(device_slots.astype(np.int32))
+                else:
+                    if probe_pending:
+                        # Tag the half-open probe cohort: only ITS successful
+                        # collection may close the breaker (_accept_work) — a
+                        # pre-outage cohort draining late must not.
+                        pending[1]["probe"] = True
+                        probe_used = True
+                    if troot is not None:
+                        # Keep the cohort trace open for the stage spans
+                        # the accept path appends (ready/collect/accept);
+                        # released there, or by the reclaim path.
+                        trace_api.TRACES.hold(troot.trace_id)
+                        pending[1]["trace"] = (
+                            troot.trace_id, troot.span_id,
+                        )
+                    gen_snap = (
+                        self.store.gen.copy() if pipelined else self.store.gen
                     )
-            except Exception as e:
-                # A dispatch that dies — whether before or after any
-                # partial bookkeeping — must strand nothing: no in-flight
-                # claim survives (none was taken yet: claims are only
-                # written below, after _dispatch returned), no cohort is
-                # queued, and the slots stay matchable next interval (the
-                # caller's expiry pass already deactivated min==max
-                # actives, so they re-activate via react_parts).
-                self._note_backend_failure("dispatch", e, crumb)
-                react_parts.append(device_slots.astype(np.int32))
-            else:
-                if probe_pending:
-                    # Tag the half-open probe cohort: only ITS successful
-                    # collection may close the breaker (_accept_work) — a
-                    # pre-outage cohort draining late must not.
-                    pending[1]["probe"] = True
-                    probe_used = True
-                gen_snap = (
-                    self.store.gen.copy() if pipelined else self.store.gen
-                )
-                work = (
-                    pending,
-                    device_slots,
-                    device_last,
-                    len(device_slots),
-                    gen_snap,
-                )
-                if pipelined:
-                    # Queue it; collection below drains only completed
-                    # results, so the dispatch computes + transfers while
-                    # the server does everything else (ticket properties
-                    # are immutable, so its candidates cannot go stale —
-                    # only dead slots, masked at collection).
-                    self._in_flight_mask[device_slots] = True
-                    self._pipeline_queue.append(work)
-                    work = None
+                    work = (
+                        pending,
+                        device_slots,
+                        device_last,
+                        len(device_slots),
+                        gen_snap,
+                    )
+                    if pipelined:
+                        # Queue it; collection below drains only completed
+                        # results, so the dispatch computes + transfers while
+                        # the server does everything else (ticket properties
+                        # are immutable, so its candidates cannot go stale —
+                        # only dead slots, masked at collection).
+                        self._in_flight_mask[device_slots] = True
+                        self._pipeline_queue.append(work)
+                        work = None
         if probe_pending and not probe_used:
             # The probe was granted but no dispatch launched (no device
             # slots, or the dispatch itself failed — the failure already
@@ -976,6 +1021,7 @@ class TpuBackend:
         ready."""
         if not self._pipeline_queue:
             return None
+        self._accepted_cohorts = []
         if block_until is not None:
             self.join_head(block_until)
         ready_works: list[tuple] = []
@@ -1044,6 +1090,10 @@ class TpuBackend:
                 n = self._reclaim_inflight(mine, "cohort collect failed")
                 crumb["collect_reclaimed"] = (
                     crumb.get("collect_reclaimed", 0) + n
+                )
+                self._close_cohort_trace(
+                    w_pending[1], status="error",
+                    message=f"collect failed: {e}",
                 )
                 return
         # The cohort's full device→host round trip succeeded: reset the
@@ -1175,7 +1225,49 @@ class TpuBackend:
             ledger["accept_lag_s"] = round(
                 _time.perf_counter() - t_disp, 3
             )
-            self.tracing.record_delivery(**ledger)
+            tctx = holder.get("trace")
+            if tctx is not None:
+                # The ledger entry names its cohort trace, so a ticket
+                # trace closed off this entry can link to it.
+                ledger["trace_id"] = tctx[0]
+            entry = self.tracing.record_delivery(**ledger)
+            self._accepted_cohorts.append((entry, good_flat))
+        self._close_cohort_trace(holder)
+
+    def _close_cohort_trace(
+        self, holder: dict, status: str = "ok", message: str = ""
+    ) -> None:
+        """Append the cohort's stage spans (ready/fetched/collected,
+        from the holder's perf stamps) to its trace and release the
+        hold taken at dispatch. Pops the ctx so the reclaim path can
+        never double-release."""
+        tctx = holder.pop("trace", None)
+        if tctx is None:
+            return
+        import time as _time
+
+        trace_id, parent = tctx
+        t_disp_pc = holder.get("t_dispatch")
+        base = holder.get("t_dispatch_wall") or _time.time()
+        if t_disp_pc is not None:
+            for name, stamp in (
+                ("cohort.ready", holder.get("t_ready")),
+                ("cohort.fetched", holder.get("t_fetched")),
+            ):
+                if stamp is not None:
+                    trace_api.emit_span(
+                        trace_id, parent, name,
+                        start_ts=base,
+                        end_ts=base + (stamp - t_disp_pc),
+                    )
+            trace_api.emit_span(
+                trace_id, parent, "cohort.collected",
+                start_ts=base,
+                end_ts=base + (_time.perf_counter() - t_disp_pc),
+                status=status, message=message,
+                breaker=self.breaker.state,
+            )
+        trace_api.TRACES.release(trace_id)
 
     def _finalize_batch(self, sel, flat_parts, size_parts, react_parts):
         if flat_parts:

@@ -199,6 +199,21 @@ class Metrics:
             "Requests currently holding an admission permit",
         )
 
+        # Tracing + SLO plane (tracing.py): tail-sampling decisions on
+        # completed traces (kept_error / kept_slow / kept_sampled /
+        # dropped) and the multi-window error-budget burn per SLO.
+        self.traces_sampled = counter(
+            "traces_sampled",
+            "Completed request traces by tail-sampling decision",
+            ("decision",),
+        )
+        self.slo_burn_rate = gauge(
+            "slo_burn_rate",
+            "Error-budget burn rate per SLO and window (1.0 = budget "
+            "spent exactly at its sustainable pace)",
+            ("slo", "window"),
+        )
+
         # Message routing / presence events.
         self.outgoing_dropped = counter(
             "socket_outgoing_dropped", "Messages dropped on full session queues"

@@ -49,6 +49,7 @@ import contextvars
 import time
 
 from . import faults
+from . import tracing as trace_api
 
 # ------------------------------------------------------- priority classes
 
@@ -359,6 +360,13 @@ class AdmissionController:
         limiter) through the same books."""
         self.shed_total += 1
         self.shed_by[(cls, reason)] += 1
+        # A shed on the active trace span: the 429's trace carries WHY
+        # it was rejected (class + reason), and error-status sampling
+        # keeps it.
+        trace_api.add_event(
+            "admission.shed",
+            **{"class": CLASS_NAMES.get(cls, cls), "reason": reason},
+        )
         if self.metrics is not None:
             try:
                 self.metrics.requests_shed.labels(
@@ -415,6 +423,16 @@ class AdmissionController:
         fut = self.try_admit(cls)
         if fut is None:
             return
+        # The wait is the observable: a request that parked behind the
+        # permit pool records when (and how long) it queued on its
+        # trace span, so "why was this request slow" names admission
+        # instead of blaming the handler.
+        t_queued = time.monotonic()
+        trace_api.add_event(
+            "admission.queued",
+            **{"class": CLASS_NAMES.get(cls, cls),
+               "queued": len(self._queues[cls])},
+        )
         timeout = None if deadline is None else max(0.0, deadline.remaining())
 
         def _granted() -> bool:
@@ -426,6 +444,10 @@ class AdmissionController:
 
         try:
             await asyncio.wait_for(fut, timeout)
+            trace_api.add_event(
+                "admission.granted",
+                wait_ms=round((time.monotonic() - t_queued) * 1000, 2),
+            )
         except asyncio.TimeoutError:
             if _granted():
                 return  # granted in the timeout race window: keep it
@@ -638,6 +660,30 @@ def breaker_signal(breaker_fn):
         if breaker is None:
             return OK
         return OK if breaker.state == "closed" else WARN
+
+    return signal
+
+
+def slo_burn_signal(recorder, warn_burn: float, shed_burn: float,
+                    escalate: bool = True):
+    """Level from the SLO plane's 5m error-budget burn (tracing.py
+    SloRecorder): sampling this signal also publishes the
+    `slo_burn_rate{slo,window}` gauges (the ladder loop is the periodic
+    context they need). With `escalate=False` the signal only publishes
+    and always reports OK — the burn is observable without feeding
+    admission policy (the default posture: first intervals pay XLA
+    compiles that would spike the burn on a fresh boot)."""
+
+    def signal() -> int:
+        recorder.sample()
+        if not escalate:
+            return OK
+        burn = recorder.max_burn("5m")
+        if burn >= shed_burn:
+            return SHED
+        if burn >= warn_burn:
+            return WARN
+        return OK
 
     return signal
 

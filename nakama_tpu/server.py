@@ -130,7 +130,45 @@ class NakamaServer:
         # doors completely unwired (self.overload None = no admission,
         # no deadlines — the pre-overload behavior).
         from . import overload as overload_mod
-        from .tracing import Tracing
+        from . import tracing as tracing_mod
+        from .tracing import SloRecorder, Tracing
+
+        # Request-scoped tracing + SLO plane (tracing.py): configure
+        # the process-wide trace store from config (tail sampling,
+        # bounds, export) and build the burn-rate recorder. The store
+        # is process-global (faults.PLANE precedent) — the last server
+        # constructed owns its metrics sink.
+        tc = config.tracing
+        tracing_mod.TRACES.configure(
+            enabled=tc.enabled,
+            capacity=tc.capacity,
+            sample_rate=tc.sample_rate,
+            slow_ms=tc.slow_trace_ms,
+            max_active=tc.max_active_traces,
+            max_spans=tc.max_spans_per_trace,
+            export_path=tc.export_path,
+            metrics=self.metrics,
+        )
+        self.slo = None
+        if tc.enabled:
+            self.slo = SloRecorder(
+                {
+                    "api_latency": {
+                        "target": tc.slo_target,
+                        "threshold_ms": tc.slo_api_latency_ms,
+                    },
+                    "matchmaker_interval": {
+                        "target": tc.slo_target,
+                        "threshold_ms": tc.slo_interval_ms,
+                    },
+                    "delivery_publish": {
+                        "target": tc.slo_target,
+                        "threshold_ms": tc.slo_publish_lag_ms,
+                    },
+                },
+                metrics=self.metrics,
+            )
+        self.matchmaker.slo = self.slo
 
         self.overload = None
         self._overload_tracing = getattr(
@@ -374,6 +412,21 @@ class NakamaServer:
                     oc.interval_lag_shed_sec,
                 ),
             )
+            if self.slo is not None:
+                # The SLO plane rides the ladder's sampling cadence:
+                # each sample publishes slo_burn_rate{slo,window}; with
+                # slo_overload_feedback on, a fast 5m burn escalates
+                # admission policy like any other signal.
+                tc = self.config.tracing
+                self.overload.register_signal(
+                    "slo_burn",
+                    overload_mod.slo_burn_signal(
+                        self.slo,
+                        tc.slo_burn_warn,
+                        tc.slo_burn_shed,
+                        escalate=tc.slo_overload_feedback,
+                    ),
+                )
             self.overload.start(max(50, oc.ladder_sample_ms) / 1000.0)
             # The admission posture in one line, like PR 4's delivery
             # line: an operator diagnosing 429s/504s reads the
@@ -392,6 +445,20 @@ class NakamaServer:
                 rate_limit_burst=oc.rate_limit_burst,
                 ladder_sample_ms=oc.ladder_sample_ms,
                 ladder_recover_samples=oc.ladder_recover_samples,
+            )
+        tc = self.config.tracing
+        if tc.enabled:
+            # The tracing posture in one line (PR 5 convention): an
+            # operator wondering why a trace is missing reads the
+            # sampling knobs off the boot log.
+            self.logger.info(
+                "tracing enabled",
+                sample_rate=tc.sample_rate,
+                slow_trace_ms=tc.slow_trace_ms,
+                capacity=tc.capacity,
+                export_path=tc.export_path or None,
+                slo_target=tc.slo_target,
+                slo_overload_feedback=tc.slo_overload_feedback,
             )
         mm_cfg = self.config.matchmaker
         if mm_cfg.interval_pipelining:

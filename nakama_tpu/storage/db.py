@@ -55,6 +55,7 @@ import time
 from typing import Any, Iterable, Sequence
 
 from .. import faults, overload
+from .. import tracing as trace_api
 from ..faults import jittered_backoff
 from .migrations import MIGRATIONS
 
@@ -88,9 +89,9 @@ class WriteConflictError(DatabaseError):
 
 
 class _WriteUnit:
-    __slots__ = ("stmts", "guards", "future", "deadline")
+    __slots__ = ("stmts", "guards", "future", "deadline", "trace")
 
-    def __init__(self, stmts, guards, future, deadline=None):
+    def __init__(self, stmts, guards, future, deadline=None, trace=None):
         self.stmts = stmts
         self.guards = guards
         self.future = future
@@ -98,6 +99,11 @@ class _WriteUnit:
         # caller carries none): the drain drops the unit instead of
         # committing a write nobody is waiting for.
         self.deadline = deadline
+        # The submitting request's (trace_id, span_id), if it ran
+        # inside an active trace: the group-commit span records every
+        # batched unit as a span link, so "which requests shared this
+        # commit" reads off one span.
+        self.trace = trace
 
 
 class _GroupAborted(Exception):
@@ -210,12 +216,25 @@ class WriteBatcher:
         await self._sem.acquire()
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._queue.append(_WriteUnit(stmts, guards, fut, deadline))
+        sp = trace_api.current_span()
+        self._queue.append(
+            _WriteUnit(
+                stmts, guards, fut, deadline,
+                trace=(
+                    (sp.trace_id, sp.span_id) if sp is not None else None
+                ),
+            )
+        )
         metrics = self._db.metrics
         if metrics is not None:
             metrics.db_write_queue_depth.set(len(self._queue))
         self._kick(loop)
-        return await fut
+        if sp is None:
+            return await fut
+        # submit→commit as a real span on the caller's trace: queue
+        # wait and the shared drain are where a "slow write" hides.
+        with trace_api.span("db.write", units=len(stmts)):
+            return await fut
 
     def _kick(self, loop) -> None:
         if self._drain_task is None or self._drain_task.done():
@@ -307,7 +326,7 @@ class WriteBatcher:
                     self._inflight = None
                     continue
             ok_count = sum(1 for ok, _ in results if ok)
-            self._note(len(batch), ok_count, time.perf_counter() - t0)
+            self._note(batch, ok_count, time.perf_counter() - t0)
             for unit, (ok, payload) in zip(batch, results):
                 if unit.future.done():
                     continue
@@ -360,7 +379,9 @@ class WriteBatcher:
             except Exception:
                 pass
 
-    def _note(self, batch_len: int, ok_count: int, dt: float) -> None:
+    def _note(self, batch: list[_WriteUnit], ok_count: int,
+              dt: float) -> None:
+        batch_len = len(batch)
         self.group_commits += 1
         self.units_committed += ok_count
         self.units_conflicted += batch_len - ok_count
@@ -376,6 +397,25 @@ class WriteBatcher:
                 batch=batch_len,
                 drain_s=dt,
                 queue_depth=len(self._queue),
+            )
+        # Group-commit span: one root span per drain that carried at
+        # least one traced unit, every batched unit attached as a span
+        # link — "which requests shared this commit" is one span read.
+        # Untraced drains (the bench writeload) skip it entirely.
+        links = [
+            {"trace_id": u.trace[0], "span_id": u.trace[1]}
+            for u in batch
+            if u.trace is not None
+        ]
+        if links:
+            now = time.time()
+            trace_api.emit_trace(
+                "db.group_commit",
+                start_ts=now - dt,
+                end_ts=now,
+                links=links,
+                batch=batch_len,
+                ok=ok_count,
             )
 
     async def flush(self):

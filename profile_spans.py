@@ -110,6 +110,27 @@ def main():
         gc.collect()
     mm.stop()
     print(f"matched_total={matched_total[0]}")
+    # PR 6 span format: per-stage delivery attribution off the Ledger,
+    # monotonic ledger totals, and the kept cohort traces (each
+    # interval dispatch is a real trace now — tail-sampled, so only
+    # error/slow/1% survive unless TRACES is reconfigured).
+    print(f"delivery_stages={backend.tracing.delivery_stage_stats()}")
+    print(f"ledger_totals={backend.tracing.ledger_totals()}")
+    from nakama_tpu.tracing import TRACES
+
+    for rec in TRACES.list(5):
+        trace = TRACES.get(rec["trace_id"]) or {"resourceSpans": []}
+        names = [
+            s["name"]
+            for rs in trace["resourceSpans"]
+            for ss in rs["scopeSpans"]
+            for s in ss["spans"]
+        ]
+        print(
+            f"trace {rec['trace_id'][:8]} root={rec['root']}"
+            f" reason={rec['reason']} dur={rec['duration_ms']}ms"
+            f" spans={names}"
+        )
 
 
 if __name__ == "__main__":

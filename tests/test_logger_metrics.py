@@ -76,6 +76,99 @@ def test_logfmt_and_stackdriver_formats():
     assert rec["timestamp"].endswith("+00:00")
 
 
+def test_log_lines_carry_trace_ids():
+    """ISSUE 6: logs↔traces correlation — a line emitted inside an
+    active trace carries trace_id/span_id (json AND the Stackdriver
+    severity path), a line outside one carries neither, and explicit
+    keys win over the ambient context."""
+    from nakama_tpu import tracing as trace_api
+
+    trace_api.TRACES.reset()
+    buf = io.StringIO()
+    log = Logger(level=logging.INFO, fmt="json", streams=[buf])
+    with trace_api.root_span("http GET /x") as root:
+        log.info("inside")
+        log.info("explicit", trace_id="override")
+    log.info("outside")
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert lines[0]["trace_id"] == root.trace_id
+    assert lines[0]["span_id"] == root.span_id
+    assert lines[1]["trace_id"] == "override"
+    assert "trace_id" not in lines[2]
+
+    buf = io.StringIO()
+    sd = Logger(level=logging.INFO, fmt="stackdriver", streams=[buf])
+    with trace_api.root_span("http GET /y") as root:
+        sd.warn("sd inside")
+    rec = json.loads(buf.getvalue())
+    assert rec["trace_id"] == root.trace_id
+    trace_api.TRACES.reset()
+
+
+# The full exposition contract: every metric name + label set on the
+# registry, snapshotted. An accidental rename or label drift breaks
+# dashboards and alert rules SILENTLY (scrapes still succeed) — this
+# golden makes it fail tier-1 instead. Additions must be added here
+# deliberately; that is the point.
+GOLDEN_EXPOSITION = {
+    ("nakama_admission_inflight", "Gauge", ()),
+    ("nakama_api_count", "Counter", ("rpc", "code")),
+    ("nakama_api_recv_bytes", "Counter", ("rpc",)),
+    ("nakama_api_sent_bytes", "Counter", ("rpc",)),
+    ("nakama_api_time_sec", "Histogram", ("rpc",)),
+    ("nakama_db_drain_restarts", "Counter", ("loop",)),
+    ("nakama_db_group_commits", "Counter", ()),
+    ("nakama_db_peak_concurrent_reads", "Gauge", ()),
+    ("nakama_db_write_batch_size", "Histogram", ()),
+    ("nakama_db_write_queue_depth", "Gauge", ()),
+    ("nakama_faults_injected", "Counter", ("point", "mode")),
+    ("nakama_matches_authoritative", "Gauge", ()),
+    ("nakama_matchmaker_active_tickets", "Gauge", ()),
+    ("nakama_matchmaker_backend_failures", "Counter", ("stage", "kind")),
+    ("nakama_matchmaker_backend_state", "Gauge", ()),
+    ("nakama_matchmaker_cohort_slipped", "Counter", ()),
+    ("nakama_matchmaker_delivery_failed", "Counter", ()),
+    ("nakama_matchmaker_delivery_lag_sec", "Histogram", ()),
+    ("nakama_matchmaker_delivery_publish_lag_sec", "Histogram", ()),
+    ("nakama_matchmaker_delivery_wakeups", "Counter", ("cause",)),
+    ("nakama_matchmaker_device_time_sec", "Histogram", ()),
+    ("nakama_matchmaker_gap_work_shed", "Counter", ()),
+    ("nakama_matchmaker_inflight_reclaimed", "Counter", ()),
+    ("nakama_matchmaker_matched", "Counter", ()),
+    ("nakama_matchmaker_process_time_sec", "Histogram", ()),
+    ("nakama_matchmaker_tickets", "Gauge", ()),
+    ("nakama_overload_state", "Gauge", ()),
+    ("nakama_parties", "Gauge", ()),
+    ("nakama_presence_event_sec", "Histogram", ()),
+    ("nakama_presences", "Gauge", ()),
+    ("nakama_request_deadline_exceeded", "Counter", ("stage",)),
+    ("nakama_requests_shed", "Counter", ("class", "reason")),
+    ("nakama_session_outgoing_overflow", "Counter", ("kind",)),
+    ("nakama_sessions", "Gauge", ()),
+    ("nakama_slo_burn_rate", "Gauge", ("slo", "window")),
+    ("nakama_socket_outgoing_dropped", "Counter", ()),
+    ("nakama_traces_sampled", "Counter", ("decision",)),
+}
+
+
+def test_prometheus_exposition_golden():
+    from prometheus_client import Counter, Gauge, Histogram
+
+    m = Metrics()
+    found = {
+        (v._name, type(v).__name__, tuple(v._labelnames))
+        for v in vars(m).values()
+        if isinstance(v, (Counter, Gauge, Histogram))
+    }
+    missing = GOLDEN_EXPOSITION - found
+    extra = found - GOLDEN_EXPOSITION
+    assert not missing and not extra, (
+        f"metric exposition drifted — renames/label changes break"
+        f" dashboards silently.\nmissing from registry: {missing}\n"
+        f"not in golden snapshot: {extra}"
+    )
+
+
 def test_rotating_file_size_rotation_and_retention(tmp_path):
     from nakama_tpu.config import LoggerConfig
     from nakama_tpu.logger import RotatingFile, setup_logging
