@@ -1599,11 +1599,755 @@ def run_chaos_main() -> int:
     return 1 if regression else 0
 
 
+# ----------------------------------------------------------------- crash
+# Crash-recovery proof (`bench.py --crash`): SIGKILL a subprocess
+# matchmaker+journal mid-interval under each armed fault point, restart
+# it, and assert the ZERO-TICKET-LOSS invariant — every acknowledged
+# (journal-durable) pre-crash ticket is matched-exactly-once or
+# recovered poolside; plus the 100k-pool recovery-time bound and the
+# disarmed journal overhead bound, all gated by the named
+# `crash_recovery_regression` (tier-1-unit-tested like the cadence /
+# overload / trace gates).
+
+CRASH_INTERVAL_BUDGET_MS = float(
+    os.environ.get("BENCH_CRASH_BUDGET_MS", 20.9)
+)
+CRASH_RECOVERY_BUDGET_S = float(
+    os.environ.get("BENCH_CRASH_RECOVERY_S", 2.0)
+)
+
+
+def crash_recovery_regression(
+    loss_violations: int,
+    double_violations: int,
+    kills_survived: int,
+    kills_total: int,
+    recovery_s: float,
+    journal_overhead_pct: float,
+) -> tuple[list, bool]:
+    """The crash-recovery gate (named + tier-1-unit-tested like PR 4's
+    cadence_regression, PR 5's overload_regression, and PR 6's
+    trace_overhead_regression, so it cannot silently rot): zero
+    acknowledged tickets lost across a SIGKILL at every armed fault
+    point, no double-match where the journal was healthy, every
+    restart recovers, full-pool recovery (snapshot load + journal
+    replay + device re-put) under CRASH_RECOVERY_BUDGET_S, and the
+    disarmed journal's interval-path cost under 1% of the 100k
+    interval budget. Returns (reasons, regression)."""
+    reasons = []
+    if loss_violations:
+        reasons.append(f"tickets_lost={loss_violations}")
+    if double_violations:
+        reasons.append(f"tickets_double_matched={double_violations}")
+    if kills_survived < kills_total:
+        reasons.append(
+            f"restarts_survived={kills_survived}/{kills_total}"
+        )
+    if recovery_s >= CRASH_RECOVERY_BUDGET_S:
+        reasons.append(
+            f"recovery {recovery_s:.2f}s >= {CRASH_RECOVERY_BUDGET_S}s"
+        )
+    if journal_overhead_pct >= 1.0:
+        reasons.append(
+            f"disarmed_journal_overhead {journal_overhead_pct:.4f}%"
+            f" >= 1% of a {CRASH_INTERVAL_BUDGET_MS}ms interval"
+        )
+    return reasons, bool(reasons)
+
+
+def _crash_cfg():
+    from nakama_tpu.config import MatchmakerConfig
+
+    return MatchmakerConfig(
+        pool_capacity=128,
+        candidates_per_ticket=16,
+        numeric_fields=4,
+        string_fields=4,
+        max_constraints=8,
+        max_intervals=500,
+    )
+
+
+async def _crash_child_main():
+    """Subprocess crash-server: matchmaker + journal + checkpoints over
+    a file-backed engine. Protocol on stdout: one `ACKED {json}` line
+    once the initial ticket batch is journal-durable, then one
+    `MATCHED {json}` line per published cohort — the parent SIGKILLs
+    us at an arbitrary point after ACKED and audits the invariant from
+    these lines plus the restarted journal."""
+    import asyncio
+
+    from nakama_tpu import faults
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+    from nakama_tpu.matchmaker.tpu import TpuBackend
+    from nakama_tpu.recovery import Checkpointer, TicketJournal
+    from nakama_tpu.storage.db import Database
+
+    dirpath = os.environ["CRASH_DIR"]
+    db = Database(os.path.join(dirpath, "crash.db"), read_pool_size=1)
+    await db.connect()
+    cfg = _crash_cfg()
+    backend = TpuBackend(cfg, test_logger(), row_block=8, col_block=16)
+
+    def on_matched(batch):
+        ids = sorted(
+            {t.ticket for i in range(len(batch)) for t in batch.tickets(i)}
+        )
+        print("MATCHED " + json.dumps({"tickets": ids}), flush=True)
+
+    mm = LocalMatchmaker(
+        test_logger(), cfg, backend=backend, on_matched=on_matched
+    )
+    journal = TicketJournal(db, test_logger())
+    mm.journal = journal
+    mm.checkpointer = Checkpointer(
+        journal,
+        db,
+        os.path.join(dirpath, "crash.ckpt"),
+        test_logger(),
+        interval_sec=0.7,
+    )
+    acked = []
+    i = 0
+
+    def add(query, strs):
+        nonlocal i
+        p = MatchmakerPresence(user_id=f"u{i}", session_id=f"s{i}")
+        i += 1
+        tid, _ = mm.add(
+            [p], p.session_id, "", query, 2, 2, 1, strs, {}
+        )
+        acked.append(tid)
+
+    # 24 matchable 1v1 pairs + 16 never-matchable tickets (each wants a
+    # mode nobody carries), so the crash always leaves real pool
+    # content behind.
+    for _ in range(48):
+        add("+properties.mode:m1", {"mode": "m1"})
+    for k in range(16):
+        add(f"+properties.mode:zz{k}", {"mode": f"xx{k}"})
+    flush_ok = await journal.flush()
+    print(
+        "ACKED "
+        + json.dumps(
+            {
+                "acked": acked,
+                "durable_lsn": journal.durable_lsn,
+                "flush_ok": flush_ok,
+            }
+        ),
+        flush=True,
+    )
+    fault = os.environ.get("CRASH_FAULT", "")
+    if fault:
+        kw = {}
+        prob = os.environ.get("CRASH_FAULT_PROB")
+        if prob:
+            kw["probability"] = float(prob)
+            kw["seed"] = 11
+        count = os.environ.get("CRASH_FAULT_COUNT")
+        if count:
+            kw["count"] = int(count)
+        faults.arm(fault, os.environ.get("CRASH_FAULT_MODE", "raise"), **kw)
+    # Churn until the parent's SIGKILL lands: intervals, mid-gap
+    # collection, checkpoints on their cadence, journal drains on the
+    # loop — the kill hits an arbitrary point of all of it.
+    while True:
+        try:
+            mm.process()
+            backend.wait_idle(timeout=10)
+            mm.collect_pipelined()
+            if mm.checkpointer.due():
+                await mm.checkpointer.maybe_checkpoint(mm)
+        except Exception as e:  # armed-fault weather: keep churning
+            print(f"CHURN-ERR {e}", file=sys.stderr, flush=True)
+        await asyncio.sleep(0.05)
+
+
+async def _crash_restart_main():
+    """Subprocess warm restart after the parent's SIGKILL: recover the
+    pool, report it + the surviving journal's matched records, then run
+    intervals to completion so re-pooled tickets rematch (the parent
+    audits those against its pre-crash MATCHED observations for the
+    double-match check)."""
+    import asyncio
+    import time as _time
+
+    from nakama_tpu import faults
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker
+    from nakama_tpu.matchmaker.tpu import TpuBackend
+    from nakama_tpu.recovery import recover
+    from nakama_tpu.storage.db import Database
+
+    dirpath = os.environ["CRASH_DIR"]
+    db = Database(os.path.join(dirpath, "crash.db"), read_pool_size=1)
+    await db.connect()
+    cfg = _crash_cfg()
+    backend = TpuBackend(cfg, test_logger(), row_block=8, col_block=16)
+    post_matches: list[str] = []
+
+    def on_matched(batch):
+        for i in range(len(batch)):
+            post_matches.extend(t.ticket for t in batch.tickets(i))
+
+    mm = LocalMatchmaker(
+        test_logger(), cfg, backend=backend, on_matched=on_matched
+    )
+    if os.environ.get("CRASH_REPLAY_FAULT"):
+        faults.arm("journal.replay", "raise", count=1)
+    stats = await recover(
+        mm,
+        db,
+        os.path.join(dirpath, "crash.ckpt"),
+        "local",
+        test_logger(),
+    )
+    # The matched records surviving in the journal tail (checkpoint-
+    # truncated ones were already reflected in the parent's MATCHED
+    # observations — publish precedes both the record and any
+    # checkpoint that could truncate it).
+    journal_matched: list[str] = []
+    rows = await db.fetch_all(
+        "SELECT op, payload FROM matchmaker_journal ORDER BY lsn"
+    )
+    for r in rows:
+        if r["op"] == "matched":
+            journal_matched.extend(
+                json.loads(r["payload"]).get("tickets", ())
+            )
+    # Run re-pooled tickets to quiescence: three empty rounds = done.
+    pool_at_recover = sorted(mm.tickets.keys())
+    quiet = 0
+    deadline = _time.perf_counter() + 60
+    while quiet < 3 and _time.perf_counter() < deadline:
+        before = len(post_matches)
+        mm.process()
+        backend.wait_idle(timeout=10)
+        mm.collect_pipelined()
+        quiet = quiet + 1 if len(post_matches) == before else 0
+        await asyncio.sleep(0.02)
+    mm.stop()
+    print(
+        "RECOVERED "
+        + json.dumps(
+            {
+                "pool_at_recover": pool_at_recover,
+                "pool": sorted(mm.tickets.keys()),
+                "journal_matched": journal_matched,
+                "post_matches": post_matches,
+                "recovery_s": stats["duration_s"],
+                "checkpoint_lsn": stats["checkpoint_lsn"],
+                "replayed_rows": stats["replayed_rows"],
+                "repooled_unpublished": stats["repooled_unpublished"],
+            }
+        ),
+        flush=True,
+    )
+    await db.close()
+
+
+def _crash_kill_phase(name, env_extra, check_double=True):
+    """One SIGKILL leg: spawn the crash child, wait for ACKED, let it
+    churn until the first published match (so the kill usually lands
+    with matched records + a checkpoint truncation behind it — the
+    interesting recovery shapes), SIGKILL mid-interval, restart, audit.
+    Returns the leg's metrics dict."""
+    import queue as queue_mod
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory(prefix=f"crash-{name}-") as tmp:
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "CRASH_DIR": tmp,
+            **env_extra,
+        }
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--crash-child"],
+            cwd=repo,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        lines: queue_mod.Queue = queue_mod.Queue()
+
+        def _reader():
+            for line in proc.stdout:
+                lines.put(line)
+            lines.put(None)
+
+        threading.Thread(target=_reader, daemon=True).start()
+        acked = None
+        observed_matched: set[str] = set()
+
+        def _pump(until, stop_on_matched=False) -> bool:
+            """Consume child lines until `until` (perf_counter) or EOF;
+            True when a MATCHED line arrived and stop_on_matched."""
+            nonlocal acked
+            while True:
+                timeout = until - time.perf_counter()
+                if timeout <= 0:
+                    return False
+                try:
+                    line = lines.get(timeout=timeout)
+                except queue_mod.Empty:
+                    return False
+                if line is None:
+                    return False
+                if line.startswith("MATCHED ") and line.endswith("\n"):
+                    try:
+                        observed_matched.update(
+                            json.loads(line[len("MATCHED "):])["tickets"]
+                        )
+                    except ValueError:
+                        pass  # torn line: skip
+                    if stop_on_matched:
+                        return True
+                if line.startswith("ACKED "):
+                    acked = json.loads(line[len("ACKED "):])
+                    return True
+
+        try:
+            assert _pump(time.perf_counter() + 180), (
+                f"{name}: child died before ACK"
+            )
+            assert acked is not None
+            # Churn until the first publish (or the cap): the kill then
+            # lands amid matched records / checkpoints / journal drains
+            # rather than always inside the first XLA compile.
+            _pump(
+                time.perf_counter()
+                + float(os.environ.get("BENCH_CRASH_MATCH_WAIT", 25)),
+                stop_on_matched=True,
+            )
+            time.sleep(float(os.environ.get("BENCH_CRASH_DELAY", 0.9)))
+        finally:
+            try:
+                proc.send_signal(signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        # Drain everything the child printed before the kill (complete
+        # lines only — a torn final line is unparseable and skipped).
+        _pump(time.perf_counter() + 30)
+        proc.wait()
+        # Warm restart in a fresh interpreter over the same files.
+        out = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--crash-restart"],
+            cwd=repo,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        survived = out.returncode == 0
+        leg = {
+            "leg": name,
+            "acked": len(acked["acked"]),
+            "observed_matched_precrash": len(observed_matched),
+            "survived": survived,
+            "loss": 0,
+            "double": 0,
+        }
+        if not survived:
+            leg["error"] = out.stderr[-1000:]
+            return leg
+        rec = None
+        for line in out.stdout.splitlines():
+            if line.startswith("RECOVERED "):
+                rec = json.loads(line[len("RECOVERED "):])
+        if rec is None:
+            leg["survived"] = False
+            leg["error"] = "no RECOVERED line"
+            return leg
+        pool = set(rec["pool"])
+        post = set(rec["post_matches"])
+        journal_matched = set(rec["journal_matched"])
+        matched_evidence = observed_matched | journal_matched
+        acked_set = set(acked["acked"])
+        # THE invariant: every acknowledged ticket is accounted for —
+        # matched pre-crash (MATCHED evidence / surviving journal
+        # records), matched exactly once after the restart, or still
+        # poolside when the restarted matchmaker quiesced.
+        lost = acked_set - matched_evidence - pool - post
+        leg["loss"] = len(lost)
+        if lost:
+            leg["lost_sample"] = sorted(lost)[:4]
+        if check_double:
+            # Exactly-once (journal healthy): a ticket with pre-crash
+            # matched EVIDENCE must not ALSO be re-pooled/re-matched
+            # after restart. Legs that fault the journal run
+            # at-least-once by design and skip this check.
+            double = matched_evidence & (pool | post)
+            leg["double"] = len(double)
+            if double:
+                leg["double_sample"] = sorted(double)[:4]
+        leg["recovery_s"] = round(rec["recovery_s"], 4)
+        leg["pool_at_recover"] = len(rec["pool_at_recover"])
+        leg["recovered_pool"] = len(pool)
+        leg["post_matches"] = len(post)
+        leg["repooled_unpublished"] = rec["repooled_unpublished"]
+        return leg
+
+
+def _crash_recovery_time_phase():
+    """Full-pool recovery time: checkpoint a 100k-ticket matchmaker
+    (snapshot through the real Checkpointer into a file-backed engine),
+    journal a post-checkpoint add tail, then measure recover() — the
+    snapshot load + journal-tail replay + device re-put — into a fresh
+    matchmaker. The acceptance bound is CRASH_RECOVERY_BUDGET_S."""
+    import asyncio
+    import gc as _gc
+    import tempfile
+
+    import numpy as np
+
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker
+    from nakama_tpu.recovery import Checkpointer, TicketJournal, recover
+    from nakama_tpu.storage.db import Database
+
+    pool = int(os.environ.get("BENCH_CRASH_POOL", NS_POOL * SCALE))
+    # Journal tail replayed at recover: models one checkpoint interval
+    # of post-snapshot adds.
+    tail = int(
+        os.environ.get(
+            "BENCH_CRASH_TAIL", min(1024, max(64, pool // 100))
+        )
+    )
+    rng = np.random.default_rng(7)
+
+    async def run():
+        with tempfile.TemporaryDirectory(prefix="crash-rec-") as tmp:
+            db = Database(f"{tmp}/rec.db", read_pool_size=1)
+            await db.connect()
+            journal = TicketJournal(db, test_logger())
+            cfg, backend = _mk_backend(pool)
+            mm = LocalMatchmaker(test_logger(), cfg, backend=backend)
+            mm.journal = journal
+            ck = Checkpointer(
+                journal, db, f"{tmp}/rec.ckpt", test_logger(),
+                interval_sec=1,
+            )
+            if os.environ.get("BENCH_VERBOSE"):
+                print(f"crash recovery-time: pool={pool}",
+                      file=sys.stderr)
+            fill(mm, rng, pool, "cr", build_ticket)
+            ck_stats = await ck.checkpoint(mm)
+            # Post-checkpoint journal tail (replayed at recover).
+            fill(mm, rng, tail, "tail", build_ticket)
+            await journal.flush()
+            mm.stop()
+            expect = len(mm.store)
+            del mm
+            del backend
+            _gc.collect()
+            # Best-of-3 (the cold-path measurement convention on this
+            # box: single-shot wall times swing ~2x with OS noise on
+            # IDENTICAL code; the min is the achievable recovery time,
+            # all runs reported).
+            runs = []
+            ok = True
+            stats = None
+            for _ in range(3):
+                cfg2, backend2 = _mk_backend(pool)
+                mm2 = LocalMatchmaker(
+                    test_logger(), cfg2, backend=backend2
+                )
+                t0 = time.perf_counter()
+                stats = await recover(
+                    mm2, db, f"{tmp}/rec.ckpt", "local", test_logger()
+                )
+                runs.append(time.perf_counter() - t0)
+                ok = ok and len(mm2.store) == expect
+                mm2.stop()
+                backend2.wait_idle(timeout=30)
+                del mm2
+                del backend2
+                _gc.collect()
+            recovery_s = min(runs)
+            await db.close()
+            return {
+                "pool": pool,
+                "tail": tail,
+                "recovery_s": recovery_s,
+                "recovery_runs_s": [round(r, 3) for r in runs],
+                "recover_stats": {
+                    k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in stats.items()
+                },
+                "checkpoint": ck_stats
+                and {
+                    "bytes": ck_stats["bytes"],
+                    "write_s": round(ck_stats["duration_s"], 3),
+                },
+                "complete": ok,
+            }
+
+    return asyncio.run(run())
+
+
+def _crash_journal_overhead_phase():
+    """Disarmed journal cost on the interval path: what process() /
+    collect_pipelined pay per call with journaling attached and no
+    fault armed — one matched-record append (closure + list append +
+    counter bump); payload serialization rides the idle-gap drain, not
+    this path. Reported as a percentage of the 100k interval budget,
+    plus the per-add append cost for context (API-path, not gated)."""
+    import numpy as np
+
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.recovery import TicketJournal
+
+    class _NullDb:
+        pass
+
+    journal = TicketJournal(_NullDb(), test_logger(), buffer_cap=1 << 20)
+    arr = np.empty(4, dtype=object)
+    resolver = lambda: arr  # noqa: E731
+
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        journal.record_matched(resolver)
+        if journal.pending > 65536:
+            journal._buf.clear()
+    per_matched_us = (time.perf_counter() - t0) / n * 1e6
+    journal._buf.clear()
+
+    class _T:
+        ticket = "t"
+        query = "*"
+        min_count = 2
+        max_count = 2
+        count_multiple = 1
+        session_id = "s"
+        party_id = ""
+        entries = ()
+        string_properties = {}
+        numeric_properties = {}
+        created_at = 0.0
+        intervals = 0
+        embedding = None
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        journal.record_add(_T())
+        if journal.pending > 65536:
+            journal._buf.clear()
+    per_add_us = (time.perf_counter() - t0) / n * 1e6
+    # The interval path appends ONE matched record per publishing call
+    # (process or mid-gap collect) — charge two per interval to stay
+    # conservative (a process + a collect in the same cycle).
+    per_interval_ms = 2 * per_matched_us / 1000.0
+    overhead_pct = per_interval_ms / CRASH_INTERVAL_BUDGET_MS * 100.0
+    return {
+        "per_matched_record_us": round(per_matched_us, 3),
+        "per_add_record_us": round(per_add_us, 3),
+        "per_interval_ms": round(per_interval_ms, 6),
+        "overhead_pct": round(overhead_pct, 6),
+    }
+
+
+def run_crash_main() -> int:
+    """`bench.py --crash`: the crash-recovery proof. SIGKILL legs at
+    each armed fault point (zero-ticket-loss + exactly-once audits),
+    a replay-fault boot-survival leg, the 100k recovery-time bound,
+    and the disarmed journal overhead bound — verdict in the single
+    `bench_all_metrics` tail line + exit code, gated by the named
+    `crash_recovery_regression`."""
+    all_metrics: dict[str, dict] = {}
+
+    def emit_json(obj: dict):
+        print(json.dumps(obj), flush=True)
+        all_metrics[obj["metric"]] = obj
+
+    legs = [
+        # (name, env, exactly_once_check) — journal-faulted legs run
+        # at-least-once by design (documented recovery semantics), so
+        # they audit zero-loss only.
+        ("baseline", {}, True),
+        (
+            "journal_append_raise",
+            {
+                "CRASH_FAULT": "journal.append",
+                "CRASH_FAULT_MODE": "raise",
+                "CRASH_FAULT_PROB": "0.5",
+            },
+            False,
+        ),
+        (
+            "journal_append_drop",
+            {
+                "CRASH_FAULT": "journal.append",
+                "CRASH_FAULT_MODE": "drop",
+                "CRASH_FAULT_PROB": "0.5",
+            },
+            False,
+        ),
+        (
+            "checkpoint_write_raise",
+            {"CRASH_FAULT": "checkpoint.write",
+             "CRASH_FAULT_MODE": "raise"},
+            True,
+        ),
+        (
+            "device_dispatch_raise",
+            {
+                "CRASH_FAULT": "device.dispatch",
+                "CRASH_FAULT_MODE": "raise",
+                "CRASH_FAULT_COUNT": "2",
+            },
+            True,
+        ),
+        (
+            # Publish dropped → the journal's `unpublished` record
+            # (full payloads) must carry the cohort across the kill
+            # and re-pool it for re-dispatch.
+            "delivery_publish_drop",
+            {
+                "CRASH_FAULT": "delivery.publish",
+                "CRASH_FAULT_MODE": "drop",
+                "CRASH_FAULT_COUNT": "1",
+            },
+            True,
+        ),
+    ]
+    loss = double = survived = 0
+    leg_results = []
+    for name, env, check_double in legs:
+        if os.environ.get("BENCH_VERBOSE"):
+            print(f"crash leg: {name}", file=sys.stderr)
+        leg = _crash_kill_phase(name, env, check_double=check_double)
+        leg_results.append(leg)
+        loss += leg["loss"]
+        double += leg["double"]
+        survived += int(leg["survived"])
+    # Replay-fault leg: an injected journal.replay failure must degrade
+    # the boot (whatever recovered, pool possibly empty), never wedge
+    # it — boot survival is the assertion, not zero-loss.
+    replay_leg = _crash_kill_phase(
+        "journal_replay_raise",
+        {"CRASH_REPLAY_FAULT": "1"},
+        check_double=False,
+    )
+    replay_leg["loss"] = 0  # loss is the injected fault's by design
+    leg_results.append(replay_leg)
+    replay_survived = replay_leg["survived"]
+    emit_json(
+        {
+            "metric": "crash_zero_ticket_loss",
+            "value": loss,
+            "unit": "tickets_lost",
+            "double_matched": double,
+            "kills_survived": survived,
+            "kills_total": len(legs),
+            "replay_fault_boot_survived": replay_survived,
+            "legs": leg_results,
+            "note": (
+                "SIGKILL mid-interval per armed fault point; every"
+                " journal-acknowledged ticket must be matched-exactly-"
+                "once (pre-crash MATCHED evidence + surviving journal"
+                " records) or recovered poolside after warm restart;"
+                " journal-faulted legs audit zero-loss only (at-least-"
+                "once is the documented degraded posture)"
+            ),
+        }
+    )
+    rec = _crash_recovery_time_phase()
+    emit_json(
+        {
+            "metric": "crash_recovery_time_s",
+            "value": round(rec["recovery_s"], 3),
+            "unit": "s",
+            **{k: v for k, v in rec.items() if k != "recovery_s"},
+            "note": (
+                "fresh-process recover(): checkpoint snapshot load +"
+                " journal-tail replay + device re-put at the 100k"
+                f" bench pool; budget {CRASH_RECOVERY_BUDGET_S}s"
+            ),
+        }
+    )
+    ovh = _crash_journal_overhead_phase()
+    emit_json(
+        {
+            "metric": "crash_journal_overhead_pct",
+            "value": ovh["overhead_pct"],
+            "unit": "%",
+            **{k: v for k, v in ovh.items() if k != "overhead_pct"},
+            "note": (
+                "disarmed journaling cost on the interval path (matched-"
+                "record append; payload serialization rides the idle-gap"
+                f" drain) vs the {CRASH_INTERVAL_BUDGET_MS}ms 100k"
+                " interval budget"
+            ),
+        }
+    )
+    reasons, regression = crash_recovery_regression(
+        loss,
+        double,
+        survived,
+        len(legs),
+        rec["recovery_s"] if rec["complete"] else CRASH_RECOVERY_BUDGET_S,
+        ovh["overhead_pct"],
+    )
+    if not rec["complete"]:
+        reasons.append("recovery_incomplete")
+        regression = True
+    if not replay_survived:
+        reasons.append("replay_fault_boot_died")
+        regression = True
+    emit_json(
+        {
+            "metric": "crash_recovery_regression",
+            "value": int(regression),
+            "reasons": reasons,
+            "regression": regression,
+        }
+    )
+    print(
+        json.dumps(
+            {"metric": "bench_all_metrics", "metrics": all_metrics}
+        ),
+        flush=True,
+    )
+    if regression:
+        print(
+            f"FAIL: crash recovery regression: {'; '.join(reasons)}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return 1 if regression else 0
+
+
 def main():
     import numpy as np
 
     import jax
 
+    if "--crash-child" in sys.argv[1:]:
+        import asyncio
+
+        asyncio.run(_crash_child_main())
+        return 0
+    if "--crash-restart" in sys.argv[1:]:
+        import asyncio
+
+        asyncio.run(_crash_restart_main())
+        return 0
+    if "--crash" in sys.argv[1:] or os.environ.get("BENCH_CRASH"):
+        # Crash-recovery-only run: the durable-journal / warm-restart
+        # proof — separable from the perf sampling like --chaos, and it
+        # writes its verdict into the same bench_all_metrics tail line.
+        return run_crash_main()
     if "--chaos" in sys.argv[1:] or os.environ.get("BENCH_CHAOS"):
         # Chaos-only run: the fault-plane proof (run_chaos_main), not
         # the performance headline — keep them separable so a chaos

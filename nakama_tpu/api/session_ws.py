@@ -12,6 +12,7 @@ callback.
 from __future__ import annotations
 
 import asyncio
+import json
 import uuid
 from typing import Any, Callable
 
@@ -112,7 +113,12 @@ class WebSocketSession:
             # it would just stack more queued work behind a dead
             # consumer — bound the flush to a short budget.
             asyncio.get_running_loop().create_task(
-                self.close("outgoing queue full", flush_timeout=0.25)
+                self.close(
+                    "outgoing queue full",
+                    flush_timeout=0.25,
+                    code=1008,
+                    kind="overflow",
+                )
             )
             return False
 
@@ -135,7 +141,7 @@ class WebSocketSession:
                     protocol.encode(envelope, self._format)
                 )
         except Exception:
-            await self.close("write error")
+            await self.close("write error", code=1011, kind="error")
 
     # ------------------------------------------------------------ consume
 
@@ -162,10 +168,57 @@ class WebSocketSession:
         finally:
             await self.close("connection closed")
 
-    async def close(self, reason: str = "", flush_timeout: float = 1.0):
+    async def close(
+        self,
+        reason: str = "",
+        flush_timeout: float = 1.0,
+        code: int = 1000,
+        kind: str = "normal",
+        retry_after_sec: float | None = None,
+    ):
+        """Close the session with a STRUCTURED close: `code` is the
+        WebSocket close code the client sees (1000 normal; the server
+        shutdown path sends 1012 Service Restart), `kind` the low-
+        cardinality reason bucket for the sessions_closed metric, and
+        `retry_after_sec` — when set — is delivered as a best-effort
+        final envelope so clients know to reconnect after a restart
+        instead of backing off blind."""
         if self._closed:
             return
         self._closed = True
+        if retry_after_sec is not None:
+            # Ahead of the writer-drain below so it flushes with any
+            # queued traffic; a full queue just drops the hint (the
+            # close code still signals restart).
+            try:
+                self._outgoing.put_nowait(
+                    {
+                        "notifications": {
+                            "notifications": [
+                                {
+                                    "subject": "server_restart",
+                                    "code": -2,
+                                    "content": json.dumps(
+                                        {
+                                            "reason": reason,
+                                            "retry_after_sec": float(
+                                                retry_after_sec
+                                            ),
+                                        }
+                                    ),
+                                    "persistent": False,
+                                }
+                            ]
+                        }
+                    }
+                )
+            except asyncio.QueueFull:
+                pass
+        if self._metrics is not None:
+            try:
+                self._metrics.sessions_closed.labels(kind).inc()
+            except Exception:
+                pass
         if self._writer_task is not None:
             if asyncio.current_task() is self._writer_task:
                 # close() reached from the writer's own error path: the
@@ -190,7 +243,12 @@ class WebSocketSession:
                     self._writer_task.cancel()
                 self._writer_task = None
         try:
-            await self.ws.close()
+            # websockets takes (code, reason); test fakes often take
+            # neither — degrade to the bare close rather than leak.
+            try:
+                await self.ws.close(code, reason)
+            except TypeError:
+                await self.ws.close()
         except Exception:
             pass
         if self._on_close is not None:

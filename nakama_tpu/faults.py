@@ -52,6 +52,9 @@ FAULT_POINTS = (
     "delivery.publish",  # LocalMatchmaker on_matched delivery
     "api.admit",         # AdmissionController.try_admit (overload.py)
     "overload.signal",   # ladder sample; drop mode forces a SHED sample
+    "journal.append",    # TicketJournal flush (recovery.py), per batch
+    "journal.replay",    # warm-restart journal replay (recovery.py)
+    "checkpoint.write",  # pool snapshot write (recovery.py), per attempt
 )
 
 

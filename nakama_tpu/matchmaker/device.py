@@ -233,6 +233,52 @@ class PoolBuffer:
         # matched-removal tail. Correctness needs rm applied before the
         # next kernel pass, and every dispatch flushes first.
 
+    def snapshot(self) -> dict:
+        """Checkpoint view of the device pool (recovery.py): ONE D2H
+        fetch per column, sliced to the high-water mark so the blob
+        scales with occupancy, not capacity. The caller must flush()
+        first so staged adds are included; staged removals are already
+        reflected in the caller's liveness masks, which gate restore-
+        side validity (a dead row's stale contents are never scored —
+        FLAG_VALID aside, the store's alive mask rules dispatch)."""
+        hw = self.high_water
+        return {
+            "high_water": hw,
+            "columns": {
+                k: np.ascontiguousarray(np.asarray(v)[:hw])
+                for k, v in self.device.items()
+            },
+        }
+
+    def load(self, snap: dict) -> None:
+        """Warm-restart restore: rebuild the device-resident pool from a
+        snapshot with one host template fill + one device_put per
+        column (sharded placement preserved) — the bulk `re-device_put`
+        path, instead of ~pool_size re-staged scatter rows."""
+        hw = int(snap["high_water"])
+        if hw > self.capacity:
+            raise ValueError(
+                f"snapshot high_water {hw} > capacity {self.capacity}"
+            )
+        host = pool_schema(self.capacity, self.fn, self.fs, self.s, self.d)
+        for k, v in snap["columns"].items():
+            host[k][:hw] = v
+        if self.sharding is not None:
+            self.device = {
+                k: jax.device_put(v, self.sharding)
+                for k, v in host.items()
+            }
+        else:
+            self.device = jax.tree.map(jnp.asarray, host)
+        self.high_water = hw
+        # Staging state resets with the buffers it described.
+        self._stage_slots[:] = -1
+        self._stage_n = 0
+        self._stage_pos.clear()
+        self._pending_add_mask[:] = False
+        self._pending_rm = []
+        self._pending_rm_n = 0
+
     def prewarm(self):
         """Compile both add-scatter pad shapes (small tail + full chunk)
         on a daemon thread: the first naturally-occurring small tail

@@ -267,6 +267,13 @@ class LocalMatchmaker:
         # SLO plane (tracing.SloRecorder, bound by the server): interval
         # wall time and publish lag observations feed the burn gauges.
         self.slo = None
+        # Crash-recovery plane (recovery.py, bound by the server's
+        # RecoveryPlane): the durable ticket journal — every add /
+        # remove / matched outcome appended (lazy payloads, drained
+        # through the group-commit write pipeline) — and the idle-gap
+        # checkpointer. None = journaling off (tests/bench default).
+        self.journal = None
+        self.checkpointer = None
         self._task: asyncio.Task | None = None
         # Event-driven delivery stage (start() spawns it alongside the
         # interval task): cohort worker threads set this event via
@@ -417,6 +424,23 @@ class LocalMatchmaker:
                             flush()
                     except Exception as e:
                         self.logger.error("gap flush error", error=str(e))
+                    if (
+                        self.checkpointer is not None
+                        and self.checkpointer.due()
+                    ):
+                        # Crash-recovery checkpoint rides the same idle
+                        # gap as GC/drain/flush: pool snapshot + journal
+                        # truncation, bounded replay for the next boot.
+                        # Failure is survivable (WARNed inside) and must
+                        # never kill the interval loop.
+                        try:
+                            await self.checkpointer.maybe_checkpoint(
+                                self
+                            )
+                        except Exception as e:
+                            self.logger.error(
+                                "checkpoint error", error=str(e)
+                            )
                 # Delivery is NOT this loop's job: the dedicated
                 # delivery stage (spawned alongside, below) wakes on the
                 # cohort-completion event the worker thread fires and
@@ -647,6 +671,8 @@ class LocalMatchmaker:
             embedding=embedding,
         )
         self._register(ticket)
+        if self.journal is not None:
+            self.journal.record_add(ticket)
         sp = trace_api.current_span()
         if sp is not None:
             slot = self.store.slot_by_id(ticket_id)
@@ -791,6 +817,7 @@ class LocalMatchmaker:
         if out is None:
             return None
         batch, matched_slots, reactivate = out
+        objs = None
         if len(matched_slots):
             self.backend.on_remove_slots(matched_slots)
             objs = self.store.remove_slots(matched_slots)
@@ -800,9 +827,11 @@ class LocalMatchmaker:
         if self.metrics is not None:
             self.metrics.mm_matched.inc(batch.entry_count if batch else 0)
             self._update_gauges()
+        published_ok = True
         if len(batch) and self.on_matched is not None:
-            self._publish(batch)
+            published_ok = self._publish(batch)
             self._stamp_published(tracing, n_ledger)
+        self._journal_matched(matched_slots, objs, published_ok)
         self._finish_ticket_traces(matched_slots, tracing)
         return batch
 
@@ -830,13 +859,15 @@ class LocalMatchmaker:
             for lag in lags:
                 self.slo.observe("delivery_publish", lag * 1000)
 
-    def _publish(self, batch: MatchBatch):
+    def _publish(self, batch: MatchBatch) -> bool:
         """Deliver a matched batch to `on_matched`, bounded by the fault
         plane's `delivery.publish` point. The tickets are already
         removed from the pool by the time delivery runs (reference
         single-shot semantics), so a failed or dropped publish is
         counted and logged loudly — the session-facing retry belongs to
-        the consumer — but it must never poison interval bookkeeping."""
+        the consumer — but it must never poison interval bookkeeping.
+        Returns publish success: a False journals the cohort as an
+        `unpublished` match so a restart re-pools its tickets."""
         try:
             if faults.fire("delivery.publish"):
                 # drop-mode chaos: delivery intentionally discarded.
@@ -846,8 +877,9 @@ class LocalMatchmaker:
                 )
                 if self.metrics is not None:
                     self.metrics.mm_delivery_failed.inc()
-                return
+                return False
             self.on_matched(batch)
+            return True
         except Exception as e:
             self.logger.error(
                 "match delivery failed",
@@ -856,6 +888,31 @@ class LocalMatchmaker:
             )
             if self.metrics is not None:
                 self.metrics.mm_delivery_failed.inc()
+            return False
+
+    def _journal_matched(self, matched_slots, objs, published_ok: bool):
+        """Journal one interval/collect call's matched outcome: ids only
+        when the cohort published (the tickets are consumed for good),
+        full payloads when it did NOT (`unpublished` — a restart
+        re-pools them for re-dispatch). `objs` is the store's removal
+        snapshot — usually the LAZY resolver, passed through unresolved
+        so serialization lands in the journal drain (idle gap), never
+        here on the delivery path."""
+        if (
+            self.journal is None
+            or matched_slots is None
+            or not len(matched_slots)
+        ):
+            return
+        if callable(objs):
+            resolver = objs
+        else:
+            arr = objs
+            resolver = lambda: (arr if arr is not None else ())  # noqa: E731
+        if published_ok:
+            self.journal.record_matched(resolver)
+        else:
+            self.journal.record_unpublished(resolver)
 
     def process(self) -> MatchBatch:
         """One matching interval (reference Process, matchmaker.go:282-441).
@@ -923,6 +980,7 @@ class LocalMatchmaker:
         if len(matched_slots):
             self.backend.on_remove_slots(matched_slots)
         t_rm2 = time.perf_counter()
+        objs = None
         if len(matched_slots):
             objs = store.remove_slots(matched_slots)
             if batch.offsets is not None:
@@ -941,9 +999,11 @@ class LocalMatchmaker:
                 "matchmaker_interval", (time.perf_counter() - t0) * 1000
             )
 
+        published_ok = True
         if len(batch) and self.on_matched is not None:
-            self._publish(batch)
+            published_ok = self._publish(batch)
             self._stamp_published(_tracing, _n_ledger)
+        self._journal_matched(matched_slots, objs, published_ok)
         self._finish_ticket_traces(matched_slots, _tracing)
         # Attribute the post-backend tail (slot removal, delivery
         # callback) on the interval's breadcrumb: the p99 work that
@@ -1013,6 +1073,19 @@ class LocalMatchmaker:
         # API callers may pass duplicate ids; the store requires unique
         # slots (a duplicate would double-free into the allocator).
         slots = np.unique(np.asarray(slots, dtype=np.int32))
+        removed_ids: list[str] = []
+        if self.journal is not None:
+            # Ids captured BEFORE the eager teardown clears ticket_at;
+            # journaled only AFTER the removal really happened (a remove
+            # record for a removal that raised would drop a live ticket
+            # at replay). Cancel-path removals are small (client/session
+            # scoped): the id walk is O(removed), not interval work.
+            ticket_at = self.store.ticket_at
+            removed_ids = [
+                ticket_at[s].ticket
+                for s in slots
+                if ticket_at[s] is not None
+            ]
         if self._ticket_traces:
             # Cancelled/removed tickets release their trace holds (no
             # matched spans — the trace finalizes with just the add).
@@ -1026,6 +1099,8 @@ class LocalMatchmaker:
         # keeps LIFO reuse (pool density). Only the interval's bulk
         # matched-removal defers to the idle-gap drain.
         self.store.remove_slots(slots, defer_free=False)
+        if self.journal is not None and removed_ids:
+            self.journal.record_remove(removed_ids)
 
     def _unregister(self, ticket_id: str):
         slot = self.store.slot_by_id(ticket_id)
@@ -1111,13 +1186,23 @@ class LocalMatchmaker:
         return out
 
     def insert(self, extracts: list[MatchmakerExtract]):
-        """Bulk-import tickets from another node (matchmaker.go:567)."""
+        """Bulk-import tickets from another node (matchmaker.go:567) or
+        the crash-recovery replay. Query ASTs are parsed once per
+        DISTINCT query across the batch — handover/replay batches
+        repeat a small canonical query set, and the shared-AST
+        discipline is already established by the checkpoint thaw
+        path (types.thaw_ticket)."""
+        parse_cache: dict[str, object] = {}
         for ex in extracts:
-            try:
-                parsed = parse_query(ex.query)
-            except QueryError:
-                self.logger.warn("insert: dropping bad query", ticket=ex.ticket)
-                continue
+            parsed = parse_cache.get(ex.query)
+            if parsed is None:
+                try:
+                    parsed = parse_cache[ex.query] = parse_query(ex.query)
+                except QueryError:
+                    self.logger.warn(
+                        "insert: dropping bad query", ticket=ex.ticket
+                    )
+                    continue
             entries = [
                 MatchmakerEntry(
                     ticket=ex.ticket,
@@ -1152,6 +1237,92 @@ class LocalMatchmaker:
                 self.logger.warn(
                     "insert: duplicate ticket", ticket=ex.ticket
                 )
+                continue
+            if self.journal is not None:
+                # Handover inserts are adds for durability purposes;
+                # recovery replay suspends the journal so replayed
+                # tickets are not re-journaled.
+                self.journal.record_add(ticket)
+
+    # ------------------------------------------------- snapshot / restore
+
+    def snapshot_state(self) -> dict:
+        """Checkpoint view of the whole matchmaker (recovery.py): the
+        slot store's columnar state + ticket objects, and — when the
+        backend keeps derived device state — its compiled pool rows and
+        mirrors, so a warm restart is bulk restores + one device_put,
+        never ~pool_size re-registrations."""
+        snap: dict = {
+            "store": self.store.snapshot(),
+            "tickets_total": len(self.store),
+        }
+        alive = self.store.alive
+        snap["max_created_seq"] = (
+            int(self.store.meta["created_seq"][alive].max())
+            if alive.any()
+            else 0
+        )
+        backend_snap = getattr(self.backend, "snapshot_state", None)
+        if backend_snap is not None:
+            snap["backend"] = backend_snap()
+        return snap
+
+    def restore_state(self, snap: dict) -> None:
+        """Warm-restart restore onto a FRESH matchmaker built from the
+        same config. Restores the store, then the backend's derived
+        state — directly when the snapshot carries a matching backend
+        section, else by re-registering each live ticket through
+        `on_add` (cross-backend restore: correct, not bulk-fast)."""
+        from .types import advance_created_seq
+
+        self.store.restore(snap["store"])
+        advance_created_seq(snap.get("max_created_seq", 0))
+        backend_restore = getattr(self.backend, "restore_state", None)
+        backend_snap = snap.get("backend")
+        if backend_restore is not None and backend_snap is not None:
+            try:
+                backend_restore(backend_snap)
+            except Exception as e:
+                # Schema drift (config changed across the restart) or a
+                # torn backend section: the store is already populated,
+                # so bailing here would leave live tickets with no
+                # device rows — permanently unmatchable zombies. Fall
+                # back to re-deriving each ticket's rows through the
+                # normal add path: slow, correct.
+                self.logger.warn(
+                    "backend snapshot restore failed; re-deriving"
+                    " device rows per ticket",
+                    error=str(e),
+                )
+                self._rederive_backend_rows()
+        elif getattr(self.backend, "snapshot_state", None) is not None:
+            # Snapshot written by a state-less backend (CPU oracle)
+            # restored onto a device backend: re-derive rows per ticket.
+            self._rederive_backend_rows()
+        self._update_gauges()
+
+    def _rederive_backend_rows(self) -> None:
+        """Rebuild the backend's per-ticket derived state through
+        `on_add` for every live slot (cross-backend/cross-schema
+        restore). A ticket the CURRENT backend rejects (e.g. embedding
+        width changed) is dropped from the pool — loudly — rather than
+        left registered-but-unmatchable."""
+        ticket_at = self.store.ticket_at
+        rejected: list[int] = []
+        for s in self.store.live_slots():
+            try:
+                self.backend.on_add(ticket_at[s], int(s))
+            except Exception as e:
+                rejected.append(int(s))
+                self.logger.warn(
+                    "restored ticket rejected by backend; dropping",
+                    ticket=ticket_at[s].ticket,
+                    error=str(e),
+                )
+        if rejected:
+            self.store.remove_slots(
+                np.asarray(rejected, dtype=np.int32), defer_free=False
+            )
 
     # -------------------------------------------------------------- helpers
 

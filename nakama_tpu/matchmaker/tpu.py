@@ -1322,6 +1322,96 @@ class TpuBackend:
         self._warm_threads = []
         self.pool.join_prewarm()
 
+    # ----------------------------------------------- snapshot / restore
+
+    def snapshot_state(self) -> dict:
+        """Checkpoint view of the backend (recovery.py): the compiled
+        device pool rows (one D2H fetch), exact query/value mirrors, and
+        the per-slot classification masks — everything on_add derives,
+        so a warm restart is bulk array restores + ONE device_put
+        instead of ~pool_size per-ticket recompiles. Sliced to the
+        high-water mark so the blob scales with occupancy."""
+        self.pool.flush()
+        hw = self.pool.high_water
+        return {
+            "backend": "tpu",
+            "schema": (
+                self.pool.capacity, self.fn, self.fs, self.s, self.d,
+            ),
+            "pool": self.pool.snapshot(),
+            "exact": {k: v[:hw].copy() for k, v in self.exact.items()},
+            "host_only_mask": self.host_only_mask[:hw].copy(),
+            "should_mask": self._should_mask[:hw].copy(),
+            "emb_mask": self._emb_mask[:hw].copy(),
+            "nonpair_mask": self._nonpair_mask[:hw].copy(),
+            "created_base": int(self._created_base),
+            "grid_lo": self._grid_lo.copy(),
+            "grid_hi": self._grid_hi.copy(),
+        }
+
+    def restore_state(self, snap: dict) -> None:
+        """Warm-restart restore onto a FRESH backend whose SlotStore was
+        already restored (the masks below cross-reference live ticket
+        objects). Pipeline state starts empty — no cohort survives a
+        process, which is exactly what the journal's unpublished-match
+        re-pooling covers."""
+        schema = (
+            self.pool.capacity, self.fn, self.fs, self.s, self.d,
+        )
+        if tuple(snap["schema"]) != schema:
+            raise ValueError(
+                f"snapshot schema {tuple(snap['schema'])} != backend"
+                f" schema {schema} (restore requires the same"
+                " matchmaker config)"
+            )
+        self.pool.load(snap["pool"])
+        hw = self.pool.high_water
+        for k, v in snap["exact"].items():
+            if k in self.exact:
+                self.exact[k][:hw] = v
+        self.host_only_mask[:hw] = snap["host_only_mask"]
+        self._should_mask[:hw] = snap["should_mask"]
+        self._should_count = int(self._should_mask.sum())
+        self._emb_mask[:hw] = snap["emb_mask"]
+        self._emb_count = int(self._emb_mask.sum())
+        self._nonpair_mask[:hw] = snap["nonpair_mask"]
+        self._nonpair_count = int(self._nonpair_mask.sum())
+        self._created_base = int(snap["created_base"])
+        self._grid_lo = np.asarray(snap["grid_lo"]).copy()
+        self._grid_hi = np.asarray(snap["grid_hi"]).copy()
+        # The id-keyed host-only view rebuilds from the mask + the
+        # restored ticket objects (few by design — budgeted fallback).
+        self.host_only = set()
+        ticket_at = self.store.ticket_at
+        for s in np.nonzero(self.host_only_mask)[0]:
+            t = ticket_at[s]
+            if t is not None:
+                self.host_only.add(t.ticket)
+        self._rebuild_ring()
+
+    def _rebuild_ring(self) -> None:
+        """Reseed the insertion-ordered dispatch ring from the restored
+        store: live slots in exact (created_at, created_seq) order."""
+        meta = self.meta
+        live = self.store.live_slots()
+        order = np.lexsort(
+            (meta["created_seq"][live], meta["created"][live])
+        )
+        live = live[order]
+        n = len(live)
+        self._ring[:n] = live
+        self._ring_valid[:n] = True
+        self._ring_valid[n:] = False
+        self._ring_pos[:] = -1
+        self._ring_pos[live] = np.arange(n, dtype=np.int64)
+        self._ring_n = n
+        self._ring_last_created = (
+            int(meta["created"][live[-1]])
+            if n
+            else np.iinfo(np.int64).min
+        )
+        self._ring_unsorted = False
+
     # ----------------------------------------------------- dispatch order
 
     def _ring_append(self, slot: int):

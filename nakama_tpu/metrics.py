@@ -136,6 +136,44 @@ class Metrics:
             "callback",
         )
 
+        # Crash-recovery plane (recovery.py): journal progress + health,
+        # checkpoint cadence, and the warm-restart outcome gauges an
+        # operator reads after a crash ("how much came back, how fast").
+        self.mm_journal_records = counter(
+            "matchmaker_journal_records",
+            "Ticket-journal records appended, by op "
+            "(add, remove, matched, unpublished)",
+            ("op",),
+        )
+        self.mm_journal_lsn = gauge(
+            "matchmaker_journal_durable_lsn",
+            "Highest journal LSN whose group commit resolved (records "
+            "at or below it survive a crash)",
+        )
+        self.mm_journal_degraded = gauge(
+            "matchmaker_journal_degraded",
+            "1 while the ticket journal is degraded to in-memory-only "
+            "after a failed write (heals on the next successful drain)",
+        )
+        self.mm_checkpoints = counter(
+            "matchmaker_checkpoints",
+            "Pool checkpoint attempts by outcome (ok, failed)",
+            ("outcome",),
+        )
+        self.mm_checkpoint_lsn = gauge(
+            "matchmaker_checkpoint_lsn",
+            "Journal LSN covered by the newest durable pool checkpoint",
+        )
+        self.mm_recovery_duration = gauge(
+            "matchmaker_recovery_duration_sec",
+            "Wall time of the last warm restart (snapshot load + "
+            "journal replay + device re-put)",
+        )
+        self.mm_recovery_tickets = gauge(
+            "matchmaker_recovery_tickets",
+            "Tickets rebuilt into the pool by the last warm restart",
+        )
+
         # Storage engine: group-commit write pipeline (storage/db.py
         # WriteBatcher) + the reader-pool concurrency high-water mark.
         # Batch-size buckets are unit counts per shared commit, not
@@ -223,6 +261,12 @@ class Metrics:
             "Per-session outgoing-queue overflow events: dropped "
             "envelopes and the queue-full session closes they trigger",
             ("kind",),
+        )
+        self.sessions_closed = counter(
+            "sessions_closed",
+            "Sessions closed, by structured reason (normal, error, "
+            "overflow, shutdown)",
+            ("reason",),
         )
         self.presence_event_time = histo(
             "presence_event_sec", "Tracker event queue latency"

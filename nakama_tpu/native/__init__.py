@@ -65,6 +65,12 @@ def load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint64,
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint64,
         ]
+        lib.ts_add_bulk.restype = ctypes.c_int32
+        lib.ts_add_bulk.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
         lib.ts_remove_slots.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
         ]
@@ -118,6 +124,43 @@ class TickStore:
             raise KeyError("duplicate ticket id hash")
         if rc == -2:
             raise RuntimeError(f"slot {slot} already occupied")
+
+    def add_bulk(
+        self,
+        slots: np.ndarray,  # i32 [n]
+        id_hashes: np.ndarray,  # u64 [n]
+        session_hashes: np.ndarray,  # u64 [n, stride]
+        session_counts: np.ndarray,  # i32 [n]
+        party_hashes: np.ndarray,  # u64 [n]
+    ):
+        """Register a whole snapshot in ONE native call (warm-restart
+        restore) — per-row semantics identical to add()."""
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        id_hashes = np.ascontiguousarray(id_hashes, dtype=np.uint64)
+        session_hashes = np.ascontiguousarray(
+            session_hashes, dtype=np.uint64
+        )
+        session_counts = np.ascontiguousarray(
+            session_counts, dtype=np.int32
+        )
+        party_hashes = np.ascontiguousarray(party_hashes, dtype=np.uint64)
+        n = len(slots)
+        stride = session_hashes.shape[1] if n else 0
+        rc = self._lib.ts_add_bulk(
+            self._h,
+            _ptr(slots, np.int32),
+            _ptr(id_hashes, np.uint64),
+            _ptr(session_hashes, np.uint64),
+            _ptr(session_counts, np.int32),
+            _ptr(party_hashes, np.uint64),
+            ctypes.c_int32(n),
+            ctypes.c_int32(stride),
+        )
+        if rc >= 0:
+            raise RuntimeError(
+                f"bulk ticket registration failed at row {rc}"
+                " (duplicate id or occupied slot)"
+            )
 
     def remove_slots(self, slots: np.ndarray):
         slots = np.ascontiguousarray(slots, dtype=np.int32)

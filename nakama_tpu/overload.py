@@ -629,6 +629,18 @@ class OverloadController:
             self._task.cancel()
             self._task = None
 
+    def enter_drain(self) -> None:
+        """Graceful-shutdown posture (server.stop): kill the sampler —
+        no calm signal may de-escalate a draining server — and walk the
+        ladder straight to SHED, so new low-priority work is rejected
+        with Retry-After while in-flight requests and matchmaker
+        cohorts finish inside the grace window."""
+        self.stop()
+        if self.state != SHED:
+            self._transition(SHED, {"drain": SHED})
+        else:
+            self.admission.set_level(SHED)
+
 
 # ------------------------------------------------------- signal builders
 

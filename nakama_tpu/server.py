@@ -123,6 +123,22 @@ class NakamaServer:
                 metrics=self.metrics,
                 tracing=getattr(self.matchmaker.backend, "tracing", None),
             )
+        # Crash-recovery plane (recovery.py): attaches the durable
+        # ticket journal + idle-gap checkpointer to the matchmaker;
+        # start() runs the warm restart once the engine is connected,
+        # stop() drains to durable (journal flush + final checkpoint).
+        self.recovery = None
+        if config.recovery.enabled:
+            from .recovery import RecoveryPlane
+
+            self.recovery = RecoveryPlane(
+                config,
+                self.db,
+                self.matchmaker,
+                log,
+                metrics=self.metrics,
+                node=node,
+            )
         # Overload-control plane (overload.py): built here so the API
         # server and pipeline can reference it; signals are registered
         # and the ladder sampler started in start() once the components
@@ -337,6 +353,25 @@ class NakamaServer:
         if not self._db_connected:
             await self.db.connect()
             self._db_connected = True
+        if self.recovery is not None:
+            # Warm restart BEFORE the matchmaker starts ticking: rebuild
+            # the host pool + device buffers from snapshot and replay
+            # the journal tail, so tickets stranded by a crash are
+            # matchable again from the first interval — and matches
+            # formed-but-unpublished at crash time re-dispatch through
+            # PR 4's delivery loop instead of being lost.
+            recovered = await self.recovery.recover()
+            rc = self.config.recovery
+            # The recovery posture in one line (PR 5 convention).
+            self.logger.info(
+                "crash recovery enabled",
+                journal=rc.journal,
+                checkpoint_interval_sec=rc.checkpoint_interval_sec,
+                checkpoint_path=self.recovery.path,
+                recovered_tickets=recovered["tickets"],
+                replayed_rows=recovered["replayed_rows"],
+                recovery_ms=round(recovered["duration_s"] * 1000, 1),
+            )
         if self.runtime is None and (
             self._runtime_modules or self.config.runtime.path
         ):
@@ -516,33 +551,85 @@ class NakamaServer:
         )
 
     async def stop(self, grace_seconds: int | None = None):
-        """Reverse-order shutdown draining matches first (main.go:209-240)."""
+        """Reverse-order shutdown draining matches first (main.go:209-240),
+        then DRAIN-TO-DURABLE (recovery.py): the overload ladder walks
+        to SHED so no new low-priority work is admitted, in-flight
+        matchmaker cohorts get the grace window to publish, sessions
+        close with a structured restart code + Retry-After hint, the
+        ticket journal flushes and a final checkpoint lands, and the
+        storage write queue COMMITS before close() — a clean SIGTERM
+        under load loses neither tickets nor acknowledged writes."""
         grace = (
             self.config.shutdown_grace_sec
             if grace_seconds is None
             else grace_seconds
         )
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(0, grace)
+        if self.overload is not None:
+            # Drain posture FIRST: reject new queue-able work with
+            # Retry-After while the front doors finish in-flight
+            # requests — the crash-only-software front half.
+            self.overload.enter_drain()
         if self.grpc is not None:
             await self.grpc.stop()
             self.grpc = None
-        if self.overload is not None:
-            self.overload.stop()
         await self.console.stop()
         await self.api.stop()
         await self.match_registry.stop_all(grace)
         self.leaderboard_scheduler.stop()
         self.google_refund_scheduler.stop()
+        # In-flight cohorts publish inside the grace window: the
+        # delivery loop is still live, so poll the pipeline until it
+        # empties or the deadline passes — a SIGTERM must not strand a
+        # formed match that one more second would have shipped. (The
+        # journal's unpublished-match records cover whatever remains.)
+        depth = getattr(self.matchmaker.backend, "pipeline_depth", None)
+        if depth is not None and grace:
+            while depth() and loop.time() < deadline:
+                await asyncio.sleep(0.05)
         self.matchmaker.stop()
+        retry_after = max(1.0, float(grace))
         for session in self.session_registry.all():
-            await session.close("server shutting down")
+            try:
+                await session.close(
+                    "server shutting down",
+                    code=1012,  # Service Restart
+                    kind="shutdown",
+                    retry_after_sec=retry_after,
+                )
+            except TypeError:
+                # Non-WS session implementations keep the plain close.
+                await session.close("server shutting down")
         self.tracker.stop()
         if self.runtime is not None:
             await self.runtime.shutdown()
-        # Close only a database we constructed; an injected one belongs to
-        # the caller (it may be shared or inspected after stop).
-        if self._db_connected and self._owns_db:
-            await self.db.close()
-            self._db_connected = False
+        if self.recovery is not None:
+            # Drain-to-durable tail: flush the journal and write one
+            # final checkpoint so the next boot replays nothing.
+            await self.recovery.shutdown()
+        if self._db_connected:
+            # Commit the queued write units BEFORE close() — close
+            # rejects whatever is still queued, which used to be the
+            # "clean SIGTERM rejects queued writes" loss. Deadline-
+            # bounded with a 1s floor so even grace=0 stops commit the
+            # backlog of an idle queue.
+            drain = getattr(self.db, "drain_writes", None)
+            if drain is not None:
+                budget = max(1.0, deadline - loop.time())
+                if not await drain(budget):
+                    self.logger.warn(
+                        "write queue not fully drained within the"
+                        " shutdown grace; remaining units will be"
+                        " rejected",
+                        budget_s=round(budget, 2),
+                    )
+            # Close only a database we constructed; an injected one
+            # belongs to the caller (it may be shared or inspected
+            # after stop).
+            if self._owns_db:
+                await self.db.close()
+                self._db_connected = False
         self.logger.info("server stopped")
 
     def issue_session(self, user_id: str, username: str) -> str:

@@ -678,6 +678,31 @@ class GroupCommitObservability:
         the batch-size distribution."""
         return self._batcher.stats()
 
+    async def drain_writes(self, timeout_s: float | None = None) -> bool:
+        """Graceful-stop seam (shared by both engines): wait —
+        deadline-bounded — until every queued write unit has drained
+        through its group commit, so a clean shutdown COMMITS the queue
+        instead of `close()` rejecting it. Returns False when the
+        deadline expired with units still queued (close() then rejects
+        the remainder loudly, the pre-drain behavior)."""
+        batcher = self._batcher
+        if timeout_s is None:
+            await batcher.flush()
+            return True
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while batcher.depth or (
+            batcher._drain_task is not None
+            and not batcher._drain_task.done()
+        ):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return batcher.depth == 0
+            try:
+                await asyncio.wait_for(batcher.flush(), remaining)
+            except asyncio.TimeoutError:
+                return batcher.depth == 0
+        return True
+
 
 class Database(GroupCommitObservability):
     def __init__(

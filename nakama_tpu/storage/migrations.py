@@ -329,4 +329,36 @@ MIGRATIONS: list[tuple[int, str, list[str]]] = [
             "CREATE INDEX IF NOT EXISTS purchase_receipt_user_idx ON purchase_receipt (user_id, create_time)",
         ],
     ),
+    (
+        7,
+        "matchmaker-journal",
+        [
+            # Crash-recovery plane (recovery.py): the append-only ticket
+            # journal — one row per MatchmakerAdd/remove/matched outcome,
+            # LSN-ordered, written through the group-commit write
+            # pipeline — and the per-node checkpoint pointer row naming
+            # the snapshot file + the LSN it covers (journal rows at or
+            # below it are redundant and truncated with the pointer
+            # update, in one atomic unit).
+            """
+            CREATE TABLE IF NOT EXISTS matchmaker_journal (
+                lsn        INTEGER NOT NULL,
+                op         TEXT NOT NULL,
+                payload    TEXT NOT NULL,
+                node       TEXT NOT NULL DEFAULT '',
+                created_at REAL NOT NULL,
+                PRIMARY KEY (node, lsn)
+            )
+            """,
+            """
+            CREATE TABLE IF NOT EXISTS matchmaker_checkpoint (
+                node       TEXT PRIMARY KEY,
+                lsn        INTEGER NOT NULL,
+                path       TEXT NOT NULL,
+                tickets    INTEGER NOT NULL,
+                created_at REAL NOT NULL
+            )
+            """,
+        ],
+    ),
 ]

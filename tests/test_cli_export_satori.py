@@ -18,11 +18,17 @@ async def test_migrations_include_purchase_receipt():
     db = Database(":memory:")
     await db.connect()
     rows = await migrate_status(db)
-    assert [r["name"] for r in rows][-1] == "purchase-receipts"
-    # Table exists and is writable.
+    names = [r["name"] for r in rows]
+    assert names[-1] == "matchmaker-journal"  # PR 7 crash-recovery plane
+    assert "purchase-receipts" in names
+    # Tables exist and are writable.
     await db.execute(
         "INSERT INTO purchase_receipt (transaction_id, user_id, store,"
         " receipt, create_time) VALUES ('t1', 'u1', 0, 'blob', 0)"
+    )
+    await db.execute(
+        "INSERT INTO matchmaker_journal (lsn, op, payload, node,"
+        " created_at) VALUES (1, 'add', '{}', 'n', 0)"
     )
     await db.close()
 
@@ -168,16 +174,19 @@ async def test_migrate_down_and_redo():
     assert reverted == [before[-1]]
     after = [r["name"] for r in await migrate_status(db)]
     assert after == before[:-1]
-    # The newest migration's table is gone.
+    # The newest migration's table is gone (matchmaker_journal since
+    # PR 7's crash-recovery plane took the top of the stack).
     import pytest as _pytest
 
     with _pytest.raises(Exception):
-        await db.fetch_one("SELECT 1 FROM purchase_receipt LIMIT 1")
+        await db.fetch_one("SELECT 1 FROM matchmaker_journal LIMIT 1")
 
     # Redo = down + up: re-applying restores the table.
     applied = await db.migrate()
     assert applied == [before[-1]]
-    assert await db.fetch_one("SELECT COUNT(*) AS n FROM purchase_receipt")
+    assert await db.fetch_one(
+        "SELECT COUNT(*) AS n FROM matchmaker_journal"
+    )
     assert [r["name"] for r in await migrate_status(db)] == before
     await db.close()
 

@@ -434,6 +434,103 @@ def test_delivery_publish_drop_and_raise_are_contained():
     mm.stop()
 
 
+async def test_journal_fault_degrades_never_wedges_interval_loop(tmp_path):
+    """ISSUE 7 satellite: the `journal.append` / `checkpoint.write`
+    fault points. A torn/failed journal write degrades the journal to
+    in-memory-only with a WARN — the interval loop keeps matching at
+    full speed — and a failing checkpoint is contained the same way;
+    disarming heals both (the journal drains its retained buffer)."""
+    from nakama_tpu.recovery import Checkpointer, TicketJournal
+    from nakama_tpu.storage.db import Database
+
+    db = Database(f"{tmp_path}/chaos.db", read_pool_size=1)
+    await db.connect()
+    mm, backend, got = make_mm()
+    journal = TicketJournal(db, quiet_logger())
+    mm.journal = journal
+    mm.checkpointer = Checkpointer(
+        journal, db, f"{tmp_path}/chaos.ckpt", quiet_logger(),
+        interval_sec=1,
+    )
+    faults.arm("journal.append", "raise")
+    faults.arm("checkpoint.write", "raise")
+    for _ in range(3):
+        add(mm)
+    await journal.flush()  # degrades in-memory, returns (no wedge)
+    assert journal.degraded and journal.pending >= 3
+    deadline = time.perf_counter() + 60
+    while not got and time.perf_counter() < deadline:
+        mm.process()
+        settle(mm, backend)
+        assert (
+            await mm.checkpointer.checkpoint(mm) is None
+        )  # failing checkpoints contained
+        mm.checkpointer._last = 0.0
+    assert got, "interval loop wedged behind a degraded journal"
+    assert faults.PLANE.fired.get("journal.append", 0) >= 1
+    assert faults.PLANE.fired.get("checkpoint.write", 0) >= 1
+    assert census_stranded(mm, backend) == 0
+    faults.disarm()
+    # Heal: the retained buffer (adds + the matched record) drains.
+    assert await journal.flush()
+    assert not journal.degraded and journal.pending == 0
+    rows = await db.fetch_all(
+        "SELECT op FROM matchmaker_journal ORDER BY lsn"
+    )
+    assert "matched" in {r["op"] for r in rows}
+    mm.stop()
+    await db.close()
+
+
+async def test_journal_stall_fault_only_delays_durability(tmp_path):
+    """`journal.append` stall mode: the drain slows, nothing breaks,
+    records still land."""
+    from nakama_tpu.recovery import TicketJournal
+    from nakama_tpu.storage.db import Database
+
+    db = Database(f"{tmp_path}/stall.db", read_pool_size=1)
+    await db.connect()
+    journal = TicketJournal(db, quiet_logger())
+    faults.arm("journal.append", "stall", stall_s=0.05)
+    journal._append("add", {"ticket": "a"})
+    t0 = time.perf_counter()
+    assert await journal.flush()
+    assert time.perf_counter() - t0 >= 0.05  # the stall really bit
+    assert journal.durable_lsn == 1
+    await db.close()
+
+
+async def test_replay_fault_boots_degraded_not_dead(tmp_path):
+    """`journal.replay` raise: a poisoned replay loses the tail but the
+    boot completes with whatever the checkpoint restored."""
+    from nakama_tpu.recovery import Checkpointer, TicketJournal, recover
+    from nakama_tpu.storage.db import Database
+
+    db = Database(f"{tmp_path}/rp.db", read_pool_size=1)
+    await db.connect()
+    mm, backend, got = make_mm()
+    journal = TicketJournal(db, quiet_logger())
+    mm.journal = journal
+    ck = Checkpointer(
+        journal, db, f"{tmp_path}/rp.ckpt", quiet_logger(), interval_sec=1
+    )
+    ckpt_covered = [add(mm) for _ in range(2)]
+    assert await ck.checkpoint(mm) is not None
+    tail_only = add(mm)
+    await journal.flush()
+    mm.stop()
+
+    mm2, backend2, _ = make_mm()
+    faults.arm("journal.replay", "raise", count=1)
+    await recover(mm2, db, f"{tmp_path}/rp.ckpt", "local", quiet_logger())
+    # Snapshot half recovered; the poisoned tail is lost — LOUDLY
+    # (error-logged), never a wedge.
+    assert set(mm2.tickets.keys()) == set(ckpt_covered)
+    assert tail_only not in mm2.tickets
+    mm2.stop()
+    await db.close()
+
+
 async def test_interval_loop_survives_armed_faults():
     """The real start() loop (satellite: interval-loop resilience): two
     1s intervals with dispatch faults armed must neither kill the loop

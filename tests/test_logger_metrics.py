@@ -125,6 +125,13 @@ GOLDEN_EXPOSITION = {
     ("nakama_matches_authoritative", "Gauge", ()),
     ("nakama_matchmaker_active_tickets", "Gauge", ()),
     ("nakama_matchmaker_backend_failures", "Counter", ("stage", "kind")),
+    ("nakama_matchmaker_checkpoint_lsn", "Gauge", ()),
+    ("nakama_matchmaker_checkpoints", "Counter", ("outcome",)),
+    ("nakama_matchmaker_journal_degraded", "Gauge", ()),
+    ("nakama_matchmaker_journal_durable_lsn", "Gauge", ()),
+    ("nakama_matchmaker_journal_records", "Counter", ("op",)),
+    ("nakama_matchmaker_recovery_duration_sec", "Gauge", ()),
+    ("nakama_matchmaker_recovery_tickets", "Gauge", ()),
     ("nakama_matchmaker_backend_state", "Gauge", ()),
     ("nakama_matchmaker_cohort_slipped", "Counter", ()),
     ("nakama_matchmaker_delivery_failed", "Counter", ()),
@@ -145,6 +152,7 @@ GOLDEN_EXPOSITION = {
     ("nakama_requests_shed", "Counter", ("class", "reason")),
     ("nakama_session_outgoing_overflow", "Counter", ("kind",)),
     ("nakama_sessions", "Gauge", ()),
+    ("nakama_sessions_closed", "Counter", ("reason",)),
     ("nakama_slo_burn_rate", "Gauge", ("slo", "window")),
     ("nakama_socket_outgoing_dropped", "Counter", ()),
     ("nakama_traces_sampled", "Counter", ("decision",)),
