@@ -249,6 +249,20 @@ class LeaderboardConfig:
     blacklist_rank_cache: list[str] = field(default_factory=list)
     callback_queue_size: int = 65_536
     callback_queue_workers: int = 8
+    # Device rank engine (leaderboard/device.py): boards at or past
+    # device_min_board_size mirror onto the device for batched rank
+    # reads; smaller boards stay host-only (the bisect oracle wins
+    # there). Write staging flushes at the dirty threshold or the
+    # interval, whichever trips first — that pair bounds read staleness.
+    device_enabled: bool = True
+    device_min_board_size: int = 4096
+    device_flush_dirty_threshold: int = 1024
+    device_flush_interval_sec: float = 2.0
+    # Deadline short-circuit: a request with less budget than this
+    # serves ranks from the host oracle instead of a device round-trip.
+    device_read_budget_ms: float = 5.0
+    device_breaker_threshold: int = 3
+    device_breaker_cooldown_ms: int = 30_000
 
 
 @dataclass

@@ -93,6 +93,9 @@ class ConsoleServer:
         r.add_get("/v2/console/match/{id}/state", self._h_match_state)
         r.add_get("/v2/console/leaderboard", self._h_leaderboard_list)
         r.add_get(
+            "/v2/console/leaderboard/device", self._h_leaderboard_device
+        )
+        r.add_get(
             "/v2/console/leaderboard/{id}", self._h_leaderboard_records
         )
         r.add_get(
@@ -811,6 +814,15 @@ class ConsoleServer:
             }
         )
 
+    async def _h_leaderboard_device(self, request: web.Request):
+        """Device rank-engine dashboard: breaker state, adopted boards
+        with their staging/flush posture, read/fallback ledger."""
+        self._auth(request)
+        engine = self.server.leaderboards.device
+        if engine is None:
+            return web.json_response({"enabled": False, "boards": []})
+        return web.json_response(engine.stats())
+
     async def _h_leaderboard_records(self, request: web.Request):
         self._auth(request)
         try:
@@ -1121,8 +1133,8 @@ class ConsoleServer:
         )
         for t in tables:
             await self.server.db.execute(f"DELETE FROM {t}")
+        self.server.leaderboards.clear_rank_state()
         await self.server.leaderboards.load()
-        self.server.leaderboards.ranks.clear_all()
         self.server.matchmaker.remove_all(self.server.matchmaker.node)
         # Deleted users' bearer tokens must die with their rows.
         self.server.session_cache.clear()

@@ -93,6 +93,7 @@ const R = {
   matchState:       ['GET',    '/v2/console/match/{id}/state'],
   matchmaker:       ['GET',    '/v2/console/matchmaker'],
   lbList:           ['GET',    '/v2/console/leaderboard'],
+  lbDevice:         ['GET',    '/v2/console/leaderboard/device'],
   lbGet:            ['GET',    '/v2/console/leaderboard/{id}/detail'],
   lbRecords:        ['GET',    '/v2/console/leaderboard/{id}'],
   lbRecordDelete:   ['DELETE', '/v2/console/leaderboard/{id}/owner/{owner_id}'],
@@ -571,12 +572,19 @@ const TABS = {
       });
   },
   leaderboards: async (el) => {
-    const d = await call('lbList');
+    const [d, dev] = await Promise.all([
+      call('lbList'), call('lbDevice'),
+    ]);
     const rows = (d.leaderboards || []).map(l =>
       `<tr><td><a href="#" data-id="${esc(l.id)}">${esc(l.id)}</a></td>
        <td>${esc(l.sort_order)}</td><td>${esc(l.operator)}</td>
        <td>${esc(l.tournament || false)}</td></tr>`).join('');
-    el.appendChild($(`<table><tr><th>id</th><th>sort</th><th>operator</th>
+    el.appendChild($(`<p>device engine: ${esc(dev.enabled
+      ? `${dev.breaker_state} · ${(dev.boards || []).length} board(s) ·
+         ${dev.device_reads || 0} device reads ·
+         ${dev.fallbacks || 0} fallbacks`
+      : 'disabled')}</p>
+      <table><tr><th>id</th><th>sort</th><th>operator</th>
       <th>tournament</th></tr>${rows}</table><div id="det"></div>`));
     el.querySelectorAll('a[data-id]').forEach(a => a.onclick = async (e) => {
       e.preventDefault();

@@ -55,6 +55,8 @@ FAULT_POINTS = (
     "journal.append",    # TicketJournal flush (recovery.py), per batch
     "journal.replay",    # warm-restart journal replay (recovery.py)
     "checkpoint.write",  # pool snapshot write (recovery.py), per attempt
+    "leaderboard.flush", # device board scatter+sort (leaderboard/device.py)
+    "leaderboard.rank",  # device rank/window/sweep read, per batch
 )
 
 

@@ -1,13 +1,16 @@
-"""Leaderboards, tournaments, rank cache, reset scheduler (reference
-server/leaderboard_cache.go, core_leaderboard.go, core_tournament.go,
-leaderboard_rank_cache.go, leaderboard_scheduler.go)."""
+"""Leaderboards, tournaments, rank cache, device rank engine, reset
+scheduler (reference server/leaderboard_cache.go, core_leaderboard.go,
+core_tournament.go, leaderboard_rank_cache.go, leaderboard_scheduler.go;
+the device engine is this port's second TPU workload — see device.py)."""
 
 from .core import Leaderboard, LeaderboardError, Leaderboards
-from .rank_cache import LeaderboardRankCache
+from .device import DeviceRankEngine
+from .rank_cache import LeaderboardRankCache, rank_cache_from_config
 from .scheduler import LeaderboardScheduler
 from .tournament import TournamentError, Tournaments
 
 __all__ = [
+    "DeviceRankEngine",
     "Leaderboard",
     "LeaderboardError",
     "LeaderboardRankCache",
@@ -15,4 +18,5 @@ __all__ = [
     "Leaderboards",
     "TournamentError",
     "Tournaments",
+    "rank_cache_from_config",
 ]

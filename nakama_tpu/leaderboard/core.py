@@ -123,14 +123,66 @@ class Leaderboards:
         logger,
         db: Database,
         rank_cache: LeaderboardRankCache | None = None,
+        device_engine=None,
     ):
         self.logger = logger.with_fields(subsystem="leaderboard")
         self.db = db
         self.ranks = rank_cache or LeaderboardRankCache()
+        # Device rank engine (device.DeviceRankEngine, optional): large
+        # boards mirror onto the device for batched reads; the rank
+        # cache above stays the oracle and the breaker-routed fallback
+        # (every device read helper returns None -> host serves).
+        self.device = device_engine
         self._cache: dict[str, Leaderboard] = {}
         # Fired after any definition change so the reset scheduler can
         # re-arm (reference leaderboardScheduler.Update call sites).
         self.on_change = None
+
+    # ------------------------------------------------------- routed reads
+
+    def _rank_get(self, id: str, expiry: float, owner_id: str) -> int:
+        if self.device is not None:
+            ranks = self.device.get_many(id, expiry, [owner_id])
+            if ranks is not None:
+                return ranks[0]
+        return self.ranks.get(id, expiry, owner_id)
+
+    def _rank_get_many(
+        self, id: str, expiry: float, owner_ids: list[str]
+    ) -> list[int]:
+        if self.device is not None:
+            ranks = self.device.get_many(id, expiry, owner_ids)
+            if ranks is not None:
+                return ranks
+        return self.ranks.get_many(id, expiry, owner_ids)
+
+    def _rank_window(
+        self, id: str, expiry: float, start: int, limit: int
+    ) -> list[tuple[str, int]]:
+        if self.device is not None:
+            window = self.device.rank_window(id, expiry, start, limit)
+            if window is not None:
+                return window
+        return self.ranks.rank_window(id, expiry, start, limit)
+
+    def reward_sweep(self, id: str, expiry: float) -> list[dict]:
+        """Final standings of one (board, expiry) bucket — the
+        end-of-tournament reward sweep. Device path: a segmented sort
+        over the board axis (engine.sweep_many); host fallback walks
+        the oracle's sorted array."""
+        if self.device is not None:
+            swept = self.device.sweep_many([(id, expiry)])
+            standings = swept.get((id, expiry))
+            if standings is not None:
+                return standings
+        return self.ranks.standings(id, expiry)
+
+    def clear_rank_state(self):
+        """Drop every rank structure, host and device (console
+        DeleteAllData)."""
+        self.ranks.clear_all()
+        if self.device is not None:
+            self.device.clear_all()
 
     # -------------------------------------------------------------- cache
 
@@ -153,6 +205,10 @@ class Leaderboards:
                     lb.id, expiry, lb.sort_order,
                     r["owner_id"], r["score"], r["subscore"],
                 )
+                if self.device is not None:
+                    self.device.record_upsert(
+                        lb.id, expiry, lb.sort_order, r["owner_id"]
+                    )
         self.logger.info("leaderboards loaded", count=len(self._cache))
 
     def get(self, id: str) -> Leaderboard | None:
@@ -230,6 +286,8 @@ class Leaderboards:
             )
         self._cache.pop(id, None)
         self.ranks.delete_leaderboard(id)
+        if self.device is not None:
+            self.device.delete_board(id)
         if self.on_change is not None:
             self.on_change()
 
@@ -402,6 +460,10 @@ class Leaderboards:
             rank = self.ranks.insert(
                 id, expiry, lb.sort_order, owner_id, new_score, new_sub
             )
+            if self.device is not None:
+                self.device.record_upsert(
+                    id, expiry, lb.sort_order, owner_id
+                )
         else:
             # A no-op "best" write must not bump the tie-break sequence —
             # that would demote the owner behind equal-scored peers.
@@ -472,7 +534,7 @@ class Leaderboards:
         rows = rows[:limit]
         records = [self._row_to_record(r) for r in rows]
         owners = [r["owner_id"] for r in records]
-        ranks = self.ranks.get_many(id, expiry, owners)
+        ranks = self._rank_get_many(id, expiry, owners)
         for pos, (record, rank) in enumerate(zip(records, ranks)):
             # Cache miss (blacklisted board): the page position is the rank
             # since the SQL order IS the rank order.
@@ -501,11 +563,11 @@ class Leaderboards:
             expiry_override if expiry_override is not None
             else lb.expiry_at(now)
         )
-        rank = self.ranks.get(id, expiry, owner_id)
+        rank = self._rank_get(id, expiry, owner_id)
         if rank < 0:
             return {"records": [], "next_cursor": "", "prev_cursor": ""}
         start = max(0, rank - limit // 2)
-        window = self.ranks.rank_window(id, expiry, start, limit)
+        window = self._rank_window(id, expiry, start, limit)
         if not window:
             return {"records": [], "next_cursor": "", "prev_cursor": ""}
         owners = [o for o, _ in window]
@@ -544,6 +606,8 @@ class Leaderboards:
             (id, expiry, owner_id),
         )
         self.ranks.delete(id, expiry, owner_id)
+        if self.device is not None:
+            self.device.record_delete(id, expiry, owner_id)
         return bool(deleted)
 
     async def records_around_owner(self, *a, **kw):

@@ -12,6 +12,16 @@ flat sorted array with C-speed bisect/insort (the skiplist's O(log n)
 read with better constants and an O(n)-memmove write that stays cheap
 well past 100k records). Batched windows (haystack, around-rank) are
 array slices — the one thing the tensor design was good at survives.
+
+Decision record, updated for the READ side (`bench.py --leaderboard`):
+that write benchmark answered the wrong question for reads at scale. N
+host bisects per batched rank query lose to ONE device searchsorted
+once boards pass a few thousand rows, so large boards are additionally
+mirrored onto the device by `device.DeviceRankEngine` — host staging
+absorbs writes at this structure's speed, batched reads ship as one
+masked searchsorted/gather per call, and THIS cache stays the oracle
+and the breaker-routed fallback. See the `leaderboard_rank_p99_us_10M`
+bench headline for the measured read-side crossover.
 """
 
 from __future__ import annotations
@@ -37,9 +47,20 @@ class _Board:
         return (score, subscore, self._seq, owner)
 
     def upsert(self, owner: str, score: int, subscore: int) -> int:
-        old = self.key_of.pop(owner, None)
+        old = self.key_of.get(owner)
         if old is not None:
+            if self.sort_order:
+                adj = (-score, -subscore)
+            else:
+                adj = (score, subscore)
+            if (old[0], old[1]) == adj:
+                # Identical (score, subscore) re-submit: keep the
+                # original seq — a fresh one would demote the owner
+                # behind every peer they previously tied ahead of
+                # (reference tie-break: earliest write wins stays won).
+                return bisect_left(self.keys, old)
             del self.keys[bisect_left(self.keys, old)]
+            del self.key_of[owner]
         key = self._key(owner, score, subscore)
         self.key_of[owner] = key
         insort(self.keys, key)
@@ -64,6 +85,21 @@ class _Board:
         return [
             (key[3], start + i)
             for i, key in enumerate(self.keys[start : start + limit])
+        ]
+
+    def standings(self) -> list[dict]:
+        """Full final standings (reward sweeps): every entry with its
+        1-based rank and de-adjusted score — one pass over the sorted
+        array (the host half of DeviceRankEngine.sweep_many)."""
+        neg = -1 if self.sort_order else 1
+        return [
+            {
+                "owner_id": key[3],
+                "rank": i + 1,
+                "score": neg * key[0],
+                "subscore": neg * key[1],
+            }
+            for i, key in enumerate(self.keys)
         ]
 
 
@@ -118,6 +154,65 @@ class LeaderboardRankCache:
             return [-1] * len(owner_ids)
         return [board.rank(o) for o in owner_ids]
 
+    def key_for(
+        self, leaderboard_id: str, expiry: float, owner_id: str
+    ) -> tuple | None:
+        """The owner's exact lexicographic key (adj_score, adj_subscore,
+        seq, owner) — the DeviceRankEngine stages and queries with this
+        same key so device and host tie-breaks agree bit-for-bit."""
+        board = self._boards.get((leaderboard_id, expiry))
+        if board is None:
+            return None
+        return board.key_of.get(owner_id)
+
+    def keys_for(
+        self, leaderboard_id: str, expiry: float, owner_ids: list[str]
+    ) -> list[tuple | None] | None:
+        """Batched `key_for`: one bound-method walk instead of a dict
+        probe chain per owner — the device read path stages thousands
+        of query keys per call, and the per-call overhead was measurable
+        against the kernel itself. None when the bucket is absent."""
+        board = self._boards.get((leaderboard_id, expiry))
+        if board is None:
+            return None
+        get = board.key_of.get
+        return [get(o) for o in owner_ids]
+
+    def items(
+        self, leaderboard_id: str, expiry: float
+    ) -> list[tuple[str, tuple]] | None:
+        """(owner, key) pairs for device-board adoption; None when the
+        bucket does not exist (blacklisted / never written)."""
+        board = self._boards.get((leaderboard_id, expiry))
+        if board is None:
+            return None
+        return list(board.key_of.items())
+
+    def restore_board(
+        self,
+        leaderboard_id: str,
+        expiry: float,
+        sort_order: int,
+        entries: list[tuple],
+    ) -> None:
+        """Rebuild one bucket from checkpointed (owner, k0, k1, seq)
+        rows with their original seqs, so tie-break order survives a
+        warm restart; the post-restore DB reload's identical-score
+        re-inserts then preserve these seqs (see _Board.upsert)."""
+        board = self._board(leaderboard_id, expiry, sort_order)
+        if board is None:
+            return
+        board.keys = []
+        board.key_of = {}
+        max_seq = board._seq
+        for owner, k0, k1, seq in entries:
+            key = (int(k0), int(k1), int(seq), owner)
+            board.key_of[owner] = key
+            board.keys.append(key)
+            max_seq = max(max_seq, int(seq))
+        board.keys.sort()
+        board._seq = max_seq
+
     def delete(self, leaderboard_id: str, expiry: float, owner_id: str):
         board = self._boards.get((leaderboard_id, expiry))
         if board is not None:
@@ -139,9 +234,27 @@ class LeaderboardRankCache:
             return []
         return board.owners_at(start, limit)
 
+    def standings(
+        self, leaderboard_id: str, expiry: float
+    ) -> list[dict]:
+        board = self._boards.get((leaderboard_id, expiry))
+        if board is None:
+            return []
+        return board.standings()
+
     def trim_expired(self, now: float) -> int:
         """Drop buckets whose expiry passed (0 = never expires)."""
         gone = [k for k in self._boards if k[1] != 0 and k[1] <= now]
         for k in gone:
             del self._boards[k]
         return len(gone)
+
+
+def rank_cache_from_config(leaderboard_config) -> LeaderboardRankCache:
+    """The one place config becomes a rank cache: server boot AND the
+    workload driver build through here so `blacklist_rank_cache` is
+    honored everywhere (a workload-constructed bare cache used to
+    silently ignore it)."""
+    return LeaderboardRankCache(
+        list(getattr(leaderboard_config, "blacklist_rank_cache", []) or [])
+    )

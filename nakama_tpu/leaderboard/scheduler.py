@@ -111,6 +111,22 @@ class LeaderboardScheduler:
                     "scheduler fire error", id=lb.id, error=str(e)
                 )
         self.lb.ranks.trim_expired(now)
+        if self.lb.device is not None:
+            # Device boards ride the same expiry buckets: a reset rolls
+            # them out of every read path, so their columns free here.
+            self.lb.device.trim_expired(now)
+
+    def _sweep(self, lb, expiry: float) -> list[dict]:
+        """Reward sweep of the closing bucket: final standings computed
+        as one segmented device sort (oracle fallback), handed to the
+        reset/end hooks so reward grants never re-walk the records."""
+        try:
+            return self.lb.reward_sweep(lb.id, expiry)
+        except Exception as e:
+            self.logger.warn(
+                "reward sweep failed", id=lb.id, error=str(e)
+            )
+            return []
 
     async def _on_reset(self, lb, reset_time: float):
         self.logger.info("leaderboard reset", id=lb.id)
@@ -121,21 +137,34 @@ class LeaderboardScheduler:
             if lb.is_tournament
             else self.runtime.leaderboard_reset()
         )
-        if hook is not None:
-            result = hook(
-                self.runtime.context(mode="reset"), lb.as_dict(), reset_time
-            )
-            if asyncio.iscoroutine(result):
-                await result
+        if hook is None:
+            return
+        # Records written during the closing period carry this reset
+        # boundary as their expiry bucket — sweep it before trim drops
+        # it from the rank structures. Only with a hook to hand it to:
+        # the sweep is a full-board sort, not a free side effect.
+        payload = lb.as_dict()
+        payload["standings"] = self._sweep(lb, reset_time)
+        result = hook(
+            self.runtime.context(mode="reset"), payload, reset_time
+        )
+        if asyncio.iscoroutine(result):
+            await result
 
     async def _on_end(self, lb):
         self.logger.info("tournament end", id=lb.id)
         if self.runtime is None:
             return
         hook = self.runtime.tournament_end()
-        if hook is not None:
-            result = hook(
-                self.runtime.context(mode="end"), lb.as_dict(), lb.end_time
-            )
-            if asyncio.iscoroutine(result):
-                await result
+        if hook is None:
+            return
+        final_expiry = lb.expiry_at(
+            max(lb.start_time, (lb.end_time or time.time()) - 1e-3)
+        )
+        payload = lb.as_dict()
+        payload["standings"] = self._sweep(lb, final_expiry)
+        result = hook(
+            self.runtime.context(mode="end"), payload, lb.end_time
+        )
+        if asyncio.iscoroutine(result):
+            await result

@@ -239,6 +239,30 @@ class Tournaments:
             (id, expiry, owner_id),
         )
         self.lb.ranks.delete(id, expiry, owner_id)
+        if self.lb.device is not None:
+            self.lb.device.record_delete(id, expiry, owner_id)
+
+    # ------------------------------------------------------------- rewards
+
+    def reward_sweep(
+        self, id: str, expiry_override: float | None = None
+    ) -> list[dict]:
+        """Final standings of the tournament's current (or given)
+        expiry bucket — the end-of-tournament reward sweep (reference
+        tournament-end hooks walk records; here one segmented device
+        sort, oracle fallback). Each entry: owner_id, 1-based rank,
+        score, subscore."""
+        t = self._get(id)
+        now = time.time()
+        if expiry_override is not None:
+            expiry = expiry_override
+        elif t.end_time and now >= t.end_time:
+            # After the end the "current" cron bucket has moved on;
+            # sweep the bucket the final window's records live in.
+            expiry = t.expiry_at(max(t.start_time, t.end_time - 1e-3))
+        else:
+            expiry = t.expiry_at(now)
+        return self.lb.reward_sweep(id, expiry)
 
     # --------------------------------------------------------------- list
 

@@ -1,0 +1,123 @@
+"""Device kernels for the leaderboard rank engine (device.py).
+
+The rank structure on the device is a *sorted score tensor*: per board,
+three int32 key columns (adjusted score, adjusted subscore, write seq —
+the same lexicographic key the host oracle keeps, minus the owner
+element the unique seq makes unreachable) plus the sort permutation
+mapping sorted position -> slot. Dead/padding slots carry PAD_KEY in
+every column so they sort past every live key and never perturb a rank.
+
+Three kernel families, all compiled over pow2-padded shapes so XLA
+builds a handful of programs, not one per board size:
+
+- `scatter_keys` — donated-buffer in-place refresh of the staged dirty
+  rows (the PoolBuffer.flush discipline from matchmaker/device.py: the
+  H2D payload is the dirty rows, never the board).
+- `sort_boards` — the segmented sort: one lexsort along the slot axis of
+  a stacked [B, C, 3] tensor re-ranks B boards in a single device pass
+  (B=1 for an ordinary flush; the scheduler's end-of-tournament reward
+  sweeps stack every closing board of a capacity bucket).
+- `lex_ranks` — the batched read: a vectorized lower-bound binary
+  search over the sorted columns answers Q owner-rank queries in
+  ceil(log2(C)) gather steps — one device call per *batch*, replacing Q
+  host bisects. `rank_of_slots` inverts the permutation (slot -> rank
+  for every live entry at once) for full-board sweeps.
+
+Everything here is shape-pure jnp so the CPU backend runs the same
+program tier-1 exercises (sized down) and a v5e runs at full pool.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Sentinel key for dead/padding slots: sorts after every live key.
+# Live keys are range-checked at staging time (device.py flips the
+# board host-only on overflow), so no live column ever equals PAD_KEY.
+PAD_KEY = np.int32(2**31 - 1)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def scatter_keys(keys: jnp.ndarray, idx: jnp.ndarray, rows: jnp.ndarray):
+    """In-place dirty-row refresh: keys [C, 3] <- rows [U, 3] at idx [U].
+    Padding duplicates repeat the last (idx, row) pair — an idempotent
+    rewrite, so scatter order never matters."""
+    return keys.at[idx].set(rows)
+
+
+@jax.jit
+def sort_boards(keys: jnp.ndarray):
+    """Segmented lexicographic sort along the slot axis.
+
+    keys [B, C, 3] -> (sorted_keys [B, C, 3], perm [B, C]) where
+    perm[b, r] is the slot holding rank r of board b. Ascending by
+    (k0, k1, k2); PAD_KEY rows land past every live rank."""
+    perm = jnp.lexsort(
+        (keys[..., 2], keys[..., 1], keys[..., 0]), axis=-1
+    )
+    sorted_keys = jnp.take_along_axis(keys, perm[..., None], axis=-2)
+    return sorted_keys, perm.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_iters",))
+def lex_ranks(sorted_keys: jnp.ndarray, q: jnp.ndarray, n_iters: int):
+    """Batched rank lookup: for each query key q[i] (int32 [Q, 3]),
+    the count of keys in `sorted_keys` [C, 3] lexicographically below
+    it — bisect_left, vectorized over the whole batch as a fixed-depth
+    binary search (n_iters >= ceil(log2(C)) + 1). A query key present
+    in the board returns exactly its sorted position."""
+    c = sorted_keys.shape[0]
+    q0, q1, q2 = q[:, 0], q[:, 1], q[:, 2]
+    lo = jnp.zeros(q.shape[0], dtype=jnp.int32)
+    hi = jnp.full(q.shape[0], c, dtype=jnp.int32)
+
+    def step(_, state):
+        lo, hi = state
+        mid = (lo + hi) >> 1  # lo < hi => mid <= C-1
+        v = sorted_keys[mid]  # [Q, 3] gather
+        less = (v[:, 0] < q0) | (
+            (v[:, 0] == q0)
+            & ((v[:, 1] < q1) | ((v[:, 1] == q1) & (v[:, 2] < q2)))
+        )
+        active = lo < hi
+        new_lo = jnp.where(active & less, mid + 1, lo)
+        new_hi = jnp.where(active & ~less, mid, hi)
+        return new_lo, new_hi
+
+    lo, _ = jax.lax.fori_loop(0, n_iters, step, (lo, hi))
+    return lo
+
+
+@jax.jit
+def rank_of_slots(perm: jnp.ndarray):
+    """Inverse permutation, segmented over the board axis: perm [B, C]
+    (rank -> slot) becomes [B, C] slot -> rank — the full-board scan a
+    reward sweep reads (every live entry's final rank in one pass)."""
+    b, c = perm.shape
+    ranks = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32), (b, c))
+    return jax.vmap(
+        lambda p, r: jnp.zeros((c,), dtype=jnp.int32).at[p].set(r)
+    )(perm, ranks)
+
+
+@functools.partial(jax.jit, static_argnames=("limit",))
+def window_slots(perm: jnp.ndarray, start: jnp.ndarray, limit: int):
+    """Around-owner / top-K window: perm [C] sliced [start, start+limit)
+    on-device, so the D2H fetch is `limit` slots, never the board."""
+    return jax.lax.dynamic_slice_in_dim(perm, start, limit)
+
+
+def pad_pow2(n: int, floor: int = 8) -> int:
+    """Pad `n` up to a power-of-two bucket (>= floor) so each kernel
+    compiles once per bucket, not once per distinct size."""
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+def n_search_iters(capacity: int) -> int:
+    """Binary-search depth covering a [0, capacity] interval."""
+    return max(1, int(capacity).bit_length() + 1)

@@ -237,6 +237,35 @@ class Metrics:
             "Requests currently holding an admission permit",
         )
 
+        # Device leaderboard rank engine (leaderboard/device.py): the
+        # breaker state an operator reads first, write-staging ->
+        # device-flush lag (the read-staleness bound the config
+        # promises), and the batch sizes the read kernels amortize —
+        # both on their own grids (lag runs to board-refresh scale;
+        # batch sizes are counts, not latencies).
+        self.lb_device_state = gauge(
+            "leaderboard_device_state",
+            "Leaderboard device-engine circuit state (0 closed, 1 open, "
+            "2 half-open)",
+        )
+        self.lb_flush_lag = Histogram(
+            "leaderboard_flush_lag_sec",
+            "Lag from first staged leaderboard write to its device flush",
+            (),
+            namespace=ns,
+            registry=self.registry,
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.5, 5.0, 10.0, 30.0),
+        )
+        self.lb_rank_batch_size = Histogram(
+            "leaderboard_rank_batch_size",
+            "Owner ranks served per batched device rank query",
+            (),
+            namespace=ns,
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+        )
+
         # Tracing + SLO plane (tracing.py): tail-sampling decisions on
         # completed traces (kept_error / kept_slow / kept_sampled /
         # dropped) and the multi-window error-budget burn per SLO.

@@ -476,6 +476,13 @@ class Checkpointer:
         # here, so a stale row is reconciled before the truncation that
         # would otherwise preserve it forever).
         self.pre_hook = None
+        # Extra checkpoint sections beyond the matchmaker pool (name ->
+        # zero-arg provider returning a picklable blob): the leaderboard
+        # device engine checkpoints its board columns through this.
+        # Providers run inline with the pool snapshot so the sections
+        # are mutually consistent; a failing provider is logged and its
+        # section skipped — never the whole checkpoint.
+        self.extra_providers: dict = {}
         self.checkpoints = 0  # ledger total (tests/console)
         self.last_lsn = 0
 
@@ -533,6 +540,17 @@ class Checkpointer:
             snap["version"] = SNAPSHOT_VERSION
             snap["journal_lsn"] = lsn
             snap["node"] = self.node
+            if self.extra_providers:
+                extras = {}
+                for name, provider in self.extra_providers.items():
+                    try:
+                        extras[name] = provider()
+                    except Exception as e:
+                        self.logger.warn(
+                            "checkpoint extra section failed; skipped",
+                            section=name, error=str(e),
+                        )
+                snap["extras"] = extras
             tickets = int(snap.get("tickets_total", 0))
             path, tmp = self.path, self.path + ".tmp"
 
@@ -613,7 +631,9 @@ class Checkpointer:
         }
 
 
-async def recover(mm, db, path: str, node: str, logger, journal=None) -> dict:
+async def recover(
+    mm, db, path: str, node: str, logger, journal=None, extras=None
+) -> dict:
     """Warm restart: snapshot load + journal-tail replay + device
     re-put, in LSN order, idempotent. Returns recovery stats. Never
     raises — a failed phase degrades to whatever earlier phases
@@ -631,13 +651,17 @@ async def recover(mm, db, path: str, node: str, logger, journal=None) -> dict:
     gc_was_enabled = gc.isenabled()
     gc.disable()
     try:
-        return await _recover_impl(mm, db, path, node, logger, journal)
+        return await _recover_impl(
+            mm, db, path, node, logger, journal, extras
+        )
     finally:
         if gc_was_enabled:
             gc.enable()
 
 
-async def _recover_impl(mm, db, path, node, logger, journal) -> dict:
+async def _recover_impl(
+    mm, db, path, node, logger, journal, extras=None
+) -> dict:
     t0 = time.perf_counter()
     log = logger.with_fields(subsystem="recovery")
     out = {
@@ -668,6 +692,18 @@ async def _recover_impl(mm, db, path, node, logger, journal) -> dict:
             ckpt_lsn = int(row["lsn"])
             out["checkpoint_lsn"] = ckpt_lsn
             out["checkpoint_tickets"] = len(mm.store)
+            # Extra checkpoint sections (leaderboard device boards, ...):
+            # each restorer is fenced on its own — a bad section costs
+            # that subsystem its warm start, never the pool's.
+            if extras:
+                for name, restorer in extras.items():
+                    try:
+                        restorer(snap.get("extras", {}).get(name))
+                    except Exception as e:
+                        log.warn(
+                            "extra checkpoint section restore failed",
+                            section=name, error=str(e),
+                        )
         except Exception as e:
             # Snapshot-covered tickets whose journal rows were truncated
             # are unrecoverable here — say so loudly instead of booting
@@ -820,6 +856,17 @@ class RecoveryPlane:
         # round would preserve it past its tickets' republication.
         self._unsettled: dict | None = None
         self.checkpointer.pre_hook = self._retry_settlement
+        # Extra checkpoint participants (leaderboard device boards):
+        # provider feeds Checkpointer, restorer is applied by recover().
+        self._extra_restorers: dict = {}
+
+    def register_extra(self, name: str, provider, restorer) -> None:
+        """Let another subsystem's state ride the pool checkpoint:
+        `provider()` -> picklable blob at snapshot time, `restorer(blob
+        | None)` at warm restart (None when the snapshot predates the
+        section)."""
+        self.checkpointer.extra_providers[name] = provider
+        self._extra_restorers[name] = restorer
 
     async def recover(self) -> dict:
         """Warm restart before the matchmaker starts: rebuild the pool
@@ -837,6 +884,7 @@ class RecoveryPlane:
                     self.node,
                     self.logger,
                     journal=self.journal,
+                    extras=self._extra_restorers,
                 )
         finally:
             self.journal.suspended = False

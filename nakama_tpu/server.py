@@ -290,17 +290,41 @@ class NakamaServer:
         self.social = HttpSocialClient()
 
         from .leaderboard import (
-            LeaderboardRankCache,
             LeaderboardScheduler,
             Leaderboards,
             Tournaments,
+            rank_cache_from_config,
         )
 
+        # The shared factory is the blacklist's single source of truth
+        # (the workload driver builds through it too).
+        lb_rank_cache = rank_cache_from_config(config.leaderboard)
+        lb_device = None
+        if config.leaderboard.device_enabled:
+            # Second TPU workload on the shared mesh: large boards
+            # mirror onto the device for batched rank reads; the host
+            # cache stays the oracle behind the engine's breaker.
+            from .leaderboard import DeviceRankEngine
+
+            lb_device = DeviceRankEngine(
+                config.leaderboard,
+                log,
+                metrics=self.metrics,
+                oracle=lb_rank_cache,
+            )
         self.leaderboards = Leaderboards(
-            log,
-            self.db,
-            LeaderboardRankCache(config.leaderboard.blacklist_rank_cache),
+            log, self.db, lb_rank_cache, device_engine=lb_device
         )
+        if self.recovery is not None and lb_device is not None:
+            # Board columns ride the PR 7 checkpoint: staged keys (seq
+            # included) snapshot with the pool and restore before
+            # load()'s DB re-inserts, preserving tie-break order across
+            # a warm restart.
+            self.recovery.register_extra(
+                "leaderboard_device",
+                lb_device.snapshot_state,
+                lb_device.restore_state,
+            )
         self.tournaments = Tournaments(self.leaderboards)
         self.leaderboard_scheduler = LeaderboardScheduler(
             log, self.leaderboards, self.tournaments, runtime=None

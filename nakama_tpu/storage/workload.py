@@ -14,12 +14,20 @@ def workload_user_ids(n: int = WORKLOAD_USERS) -> list[str]:
     return [f"00000000-0000-4000-8000-{i:012d}" for i in range(n)]
 
 
-async def setup_mixed_workload(db, log, leaderboard_id: str):
+async def setup_mixed_workload(db, log, leaderboard_id: str, config=None):
     """Seed the users and leaderboard the mixed writers target; returns
-    ``(users, wallets, leaderboards)`` ready for `run_mixed_writer`."""
+    ``(users, wallets, leaderboards)`` ready for `run_mixed_writer`.
+
+    ``config`` (a full server Config) threads the leaderboard section
+    through the shared rank-cache factory so workload-driven boards
+    honor ``blacklist_rank_cache`` exactly like server-driven ones — a
+    bare ``LeaderboardRankCache()`` here used to silently ignore it."""
     from ..core.wallet import Wallets
     from ..leaderboard.core import Leaderboards
-    from ..leaderboard.rank_cache import LeaderboardRankCache
+    from ..leaderboard.rank_cache import (
+        LeaderboardRankCache,
+        rank_cache_from_config,
+    )
 
     users = workload_user_ids()
     for i, uid in enumerate(users):
@@ -29,7 +37,12 @@ async def setup_mixed_workload(db, log, leaderboard_id: str):
             (uid, f"w{i}"),
         )
     wallets = Wallets(log, db)
-    lbs = Leaderboards(log, db, LeaderboardRankCache())
+    rank_cache = (
+        rank_cache_from_config(config.leaderboard)
+        if config is not None
+        else LeaderboardRankCache()
+    )
+    lbs = Leaderboards(log, db, rank_cache)
     await lbs.create(leaderboard_id, sort_order="desc")
     return users, wallets, lbs
 

@@ -887,3 +887,148 @@ def test_chaos_soak_fixed_seed():
     assert sum(b.entry_count for b in got) > 0
     assert int(backend._in_flight_mask.sum()) == 0
     mm.stop()
+
+
+# ------------------------------------------------- leaderboard device plane
+
+
+async def test_leaderboard_faults_degrade_to_oracle_never_wedge():
+    """ISSUE 8: chaos over the device rank engine — `leaderboard.rank`
+    and `leaderboard.flush` armed with seeded probabilities while mixed
+    writes + routed reads run through the full Leaderboards path. The
+    ladder must hold: no exception escapes a read, every degraded read
+    is served (host oracle fallback), per-read latency stays under an
+    absolute bound, and after disarm + cooldown the device path heals
+    to exact host parity."""
+    import random as random_mod
+
+    from fixtures import quiet_logger
+
+    from nakama_tpu.config import LeaderboardConfig
+    from nakama_tpu.leaderboard import (
+        DeviceRankEngine,
+        LeaderboardRankCache,
+        Leaderboards,
+    )
+    from nakama_tpu.storage.db import Database
+
+    rng = random_mod.Random(77)
+    db = Database(":memory:")
+    await db.connect()
+    oracle = LeaderboardRankCache()
+    engine = DeviceRankEngine(
+        LeaderboardConfig(
+            device_min_board_size=0,
+            device_flush_dirty_threshold=8,
+            device_flush_interval_sec=0.02,
+            device_breaker_threshold=2,
+            device_breaker_cooldown_ms=30,
+        ),
+        quiet_logger(),
+        oracle=oracle,
+    )
+    lb = Leaderboards(quiet_logger(), db, oracle, device_engine=engine)
+    await lb.load()
+    await lb.create("chaos", sort_order="desc")
+    owners = [f"c{i}" for i in range(48)]
+    for o in owners:
+        await lb.record_write("chaos", o, score=rng.randrange(40))
+    faults.arm("leaderboard.rank", "raise", probability=0.25, seed=4)
+    faults.arm("leaderboard.flush", "raise", probability=0.25, seed=5)
+    read_walls = []
+    try:
+        for step in range(120):
+            o = rng.choice(owners)
+            op = step % 4
+            if op == 0:
+                await lb.record_write("chaos", o,
+                                      score=rng.randrange(40))
+            elif op == 1:
+                t0 = time.perf_counter()
+                ranks = lb._rank_get_many("chaos", 0.0, owners[:16])
+                read_walls.append(time.perf_counter() - t0)
+                n = oracle.count("chaos", 0.0)
+                assert len(ranks) == 16
+                assert all(-1 <= r <= n for r in ranks)
+            elif op == 2:
+                hay = await lb.records_haystack("chaos", o, limit=5)
+                assert isinstance(hay["records"], list)
+            else:
+                page = await lb.records_list("chaos", limit=8)
+                assert len(page["records"]) == 8
+            if step % 30 == 29:
+                time.sleep(0.05)  # let half-open probes through
+    finally:
+        faults.disarm()
+    # Bounded degradation: absolute per-read wall (ratio gates flake on
+    # this box — see the chaos-gate memory note), generous for CI noise.
+    read_walls.sort()
+    assert read_walls[int(len(read_walls) * 0.99)] < 1.0
+    # Heal: cooldown passes, the device serves again and agrees with
+    # the oracle exactly once reflushed.
+    time.sleep(engine.breaker.cooldown_s + 0.05)
+    healed = None
+    for _ in range(4):
+        healed = engine.get_many("chaos", 0.0, owners)
+        if healed is not None:
+            break
+        time.sleep(engine.breaker.cooldown_s + 0.05)
+    assert healed == oracle.get_many("chaos", 0.0, owners)
+    assert engine.breaker.state == "closed"
+    assert engine.breaker.opens >= 1  # the chaos really tripped it
+    await db.close()
+
+
+async def test_leaderboard_drop_faults_serve_stale_then_converge():
+    """Drop-mode chaos: a dropped flush keeps serving the last good
+    sort (bounded staleness, by design), a dropped rank read falls back
+    to the oracle — neither raises, and both converge after disarm."""
+    from fixtures import quiet_logger
+
+    from nakama_tpu.config import LeaderboardConfig
+    from nakama_tpu.leaderboard import (
+        DeviceRankEngine,
+        LeaderboardRankCache,
+    )
+
+    oracle = LeaderboardRankCache()
+    engine = DeviceRankEngine(
+        LeaderboardConfig(
+            device_min_board_size=0,
+            device_flush_dirty_threshold=4,
+            device_flush_interval_sec=0.01,
+            device_breaker_threshold=2,
+            device_breaker_cooldown_ms=30,
+        ),
+        quiet_logger(),
+        oracle=oracle,
+    )
+    for i in range(20):
+        oracle.insert("d", 0.0, 1, f"u{i}", i, 0)
+        engine.record_upsert("d", 0.0, 1, f"u{i}")
+    owners = [f"u{i}" for i in range(20)]
+    assert engine.get_many("d", 0.0, owners) == oracle.get_many(
+        "d", 0.0, owners
+    )
+    # Dirty the board past the threshold, then drop every flush: the
+    # read still answers from the stale sort (no exception, no wedge).
+    for i in range(8):
+        oracle.insert("d", 0.0, 1, f"u{i}", 100 + i, 0)
+        engine.record_upsert("d", 0.0, 1, f"u{i}")
+    faults.arm("leaderboard.flush", "drop")
+    try:
+        stale = engine.get_many("d", 0.0, owners)
+        assert stale is not None and len(stale) == 20
+    finally:
+        faults.disarm("leaderboard.flush")
+    # Dropped rank reads fall back (None -> oracle serves).
+    faults.arm("leaderboard.rank", "drop", count=2)
+    try:
+        assert engine.get_many("d", 0.0, owners) is None
+        assert engine.breaker.state == "closed"  # drop != failure
+    finally:
+        faults.disarm()
+    # Disarmed: the next read flushes and converges exactly.
+    assert engine.get_many("d", 0.0, owners) == oracle.get_many(
+        "d", 0.0, owners
+    )

@@ -105,6 +105,66 @@ def test_rank_cache_orders_and_batches():
     assert rc.trim_expired(now=100.0) == 1
 
 
+def test_identical_resubmit_preserves_tie_order():
+    """ISSUE 8 satellite: re-posting an identical (score, subscore)
+    must keep the original tie-break seq — the old behavior assigned a
+    fresh seq and silently demoted the owner behind every peer they
+    previously tied ahead of."""
+    rc = LeaderboardRankCache()
+    rc.insert("board", 0, 1, "first", 30, 0)
+    rc.insert("board", 0, 1, "second", 30, 0)
+    assert rc.get("board", 0, "first") == 0
+    # Identical re-submit: rank unchanged, still ahead of the tie.
+    assert rc.insert("board", 0, 1, "first", 30, 0) == 0
+    assert rc.get("board", 0, "first") == 0
+    assert rc.get("board", 0, "second") == 1
+    # A genuinely different score still re-ranks (and re-seqs).
+    rc.insert("board", 0, 1, "first", 29, 0)
+    assert rc.get("board", 0, "first") == 1
+    rc.insert("board", 0, 1, "first", 30, 0)  # back to a tie: newest
+    assert rc.get("board", 0, "first") == 1
+    # Subscore-only change is a real change too.
+    asc = LeaderboardRankCache()
+    asc.insert("g", 0, 0, "x", 10, 5)
+    asc.insert("g", 0, 0, "y", 10, 5)
+    assert asc.insert("g", 0, 0, "x", 10, 5) == 0  # identical: kept
+    asc.insert("g", 0, 0, "x", 10, 4)
+    assert asc.get("g", 0, "x") == 0  # better subscore re-ranks
+
+
+async def test_workload_honors_rank_cache_blacklist():
+    """ISSUE 8 satellite: storage/workload.py used to build a bare
+    LeaderboardRankCache, ignoring config.leaderboard
+    .blacklist_rank_cache — the shared factory threads it through."""
+    from nakama_tpu.config import Config
+    from nakama_tpu.storage.db import Database
+    from nakama_tpu.storage.workload import setup_mixed_workload
+
+    cfg = Config()
+    cfg.leaderboard.blacklist_rank_cache = ["wl_board"]
+    db = Database(":memory:")
+    await db.connect()
+    try:
+        users, wallets, lbs = await setup_mixed_workload(
+            db, quiet_logger(), "wl_board", config=cfg
+        )
+        r = await lbs.record_write("wl_board", users[0], score=10)
+        # Blacklisted: no rank cached (the record itself still lands).
+        assert r["rank"] == 0
+        assert lbs.ranks.count("wl_board", 0.0) == 0
+        # Without config the legacy default (no blacklist) holds.
+        db2 = Database(":memory:")
+        await db2.connect()
+        _, _, lbs2 = await setup_mixed_workload(
+            db2, quiet_logger(), "wl_board"
+        )
+        r2 = await lbs2.record_write("wl_board", users[0], score=10)
+        assert r2["rank"] == 1
+        await db2.close()
+    finally:
+        await db.close()
+
+
 def test_rank_cache_beats_skiplist_shape():
     """The SURVEY §7.9 decision record, kept honest with numbers: on the
     record_write workload (every write wants its rank), a lazily-resorted

@@ -122,6 +122,9 @@ GOLDEN_EXPOSITION = {
     ("nakama_db_write_batch_size", "Histogram", ()),
     ("nakama_db_write_queue_depth", "Gauge", ()),
     ("nakama_faults_injected", "Counter", ("point", "mode")),
+    ("nakama_leaderboard_device_state", "Gauge", ()),
+    ("nakama_leaderboard_flush_lag_sec", "Histogram", ()),
+    ("nakama_leaderboard_rank_batch_size", "Histogram", ()),
     ("nakama_matches_authoritative", "Gauge", ()),
     ("nakama_matchmaker_active_tickets", "Gauge", ()),
     ("nakama_matchmaker_backend_failures", "Counter", ("stage", "kind")),
