@@ -1210,6 +1210,247 @@ def run_trace_overhead_main() -> int:
     return 1 if regression else 0
 
 
+# -------------------------------------------------------- device telemetry
+
+DEVOBS_POOL = int(os.environ.get("BENCH_DEVOBS_POOL", 512))
+DEVOBS_LB_POOL = int(os.environ.get("BENCH_DEVOBS_LB_POOL", 2048))
+
+
+def device_telemetry_overhead_regression(
+    overhead_pct,
+    kernels_n=1,
+    compiles_total=1,
+    memory_owners=1,
+) -> tuple[list, bool]:
+    """The device-telemetry gate (named + tier-1-unit-tested like the
+    cadence/overload/trace/crash/leaderboard gates, so it cannot
+    silently rot): the always-on plane — kernel clocks, compile-watch,
+    HBM ledger — must cost under 1% of the 100k-ticket interval budget,
+    AND the workloads leg must have produced non-empty telemetry (a
+    plane that is cheap because its hooks silently stopped firing is a
+    worse regression than a slow one). Returns (reasons, regression)."""
+    reasons = []
+    if overhead_pct >= 1.0:
+        reasons.append(
+            f"device_telemetry_overhead {overhead_pct:.4f}% >= 1% of a"
+            f" {TRACE_INTERVAL_BUDGET_MS}ms interval"
+        )
+    if kernels_n <= 0:
+        reasons.append(
+            "no named kernels recorded calls after one matchmaker"
+            " interval + one leaderboard flush"
+        )
+    if compiles_total <= 0:
+        reasons.append(
+            "compile-watch attributed zero XLA compiles — the"
+            " monitoring listener is not firing"
+        )
+    if memory_owners <= 0:
+        reasons.append("the HBM ownership ledger is empty")
+    return reasons, bool(reasons)
+
+
+def _measure_devobs_costs() -> dict:
+    """Per-call cost of every telemetry hook the 100k interval path
+    pays, measured hot at the production posture (enabled, warmed)."""
+    from nakama_tpu.devobs import DEVOBS
+
+    DEVOBS.reset()
+    DEVOBS.mark_warm()
+    out = {}
+
+    # Disarmed posture (enabled=False): the cost the knob buys back.
+    DEVOBS.configure(enabled=False)
+    n = 1_000_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with DEVOBS.device_call("bench.kernel"):
+            pass
+    out["disarmed_call_ns"] = (time.perf_counter() - t0) / n * 1e9
+    DEVOBS.configure(enabled=True)
+
+    # One armed kernel clock wrap (perf_counter x2, ring/timeline
+    # appends, EMA) — the per-device-call cost.
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with DEVOBS.device_call("bench.kernel"):
+            pass
+    out["armed_call_us"] = (time.perf_counter() - t0) / n * 1e6
+
+    # One transfer-counter tick and one memory-ledger write.
+    n = 500_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        DEVOBS.transfer("bench.site", "h2d", 4096)
+    out["transfer_us"] = (time.perf_counter() - t0) / n * 1e6
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        DEVOBS.mem_add("bench.owner", 7)
+    out["mem_add_us"] = (time.perf_counter() - t0) / n * 1e6
+
+    # The once-per-interval pieces: the warmup tick and the delivery
+    # ledger's timeline slice over a FULL timeline deque.
+    n = 500_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        DEVOBS.interval_tick()
+    out["interval_tick_ns"] = (time.perf_counter() - t0) / n * 1e9
+    now = time.time()
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        DEVOBS.timeline_between(now - 60, now + 60)
+    out["timeline_slice_us"] = (time.perf_counter() - t0) / n * 1e6
+    DEVOBS.reset()
+    return out
+
+
+def _devobs_workloads_phase() -> dict:
+    """Both accelerator workloads through the armed plane on one
+    process — the acceptance leg: after one matchmaker interval + one
+    leaderboard flush, kernels/compiles/memory-by-owner must all be
+    non-empty, and the per-workload HBM numbers come out as the
+    measured shared-mesh occupancy split."""
+    import numpy as np
+
+    from nakama_tpu.devobs import DEVOBS
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker
+
+    DEVOBS.reset()
+    DEVOBS.configure(enabled=True)
+    rng = np.random.default_rng(7)
+    cfg, backend = _mk_backend(DEVOBS_POOL)
+    mm = LocalMatchmaker(test_logger(), cfg, backend=backend)
+    fill(mm, rng, DEVOBS_POOL, "dv")
+    mm.process()
+    backend.wait_idle()
+    mm.process()  # collect the pipelined cohort: fetch clocks fire
+    backend.wait_idle()
+
+    oracle, engine, owners, _, _ = _lb_build_phase(DEVOBS_LB_POOL)
+    engine.get_many("bench", 0.0, owners[:64])
+
+    stats = DEVOBS.stats()
+    active = [k for k in stats["kernels"] if k["calls"] > 0]
+    mem = stats["memory"]["by_owner"]
+    out = {
+        "kernels_active": len(active),
+        "kernels": {k["kernel"]: k["calls"] for k in active},
+        "compiles_total": stats["compiles"]["total"],
+        "recompiles_total": stats["compiles"]["recompiles_total"],
+        "memory_by_owner": mem,
+        "transfer_sites": len(stats["transfers"]),
+        "matchmaker_pool_mb": round(
+            mem.get("matchmaker.pool", 0) / 1e6, 2
+        ),
+        "leaderboard_boards_mb": round(
+            mem.get("leaderboard.boards", 0) / 1e6, 2
+        ),
+    }
+    mm.stop()
+    return out
+
+
+def run_device_obs_main() -> int:
+    """`bench.py --device-obs`: the device-telemetry proof. Measures
+    the per-hook costs hot, composes them into the per-interval total
+    the 100k path pays (~8 kernel wraps + ~6 transfer ticks + the
+    dispatch-ring mem adds + the once-per-interval tick/timeline
+    slice), runs both workloads through the armed plane, and gates
+    <1% + non-empty telemetry via the named, tier-1-unit-tested
+    `device_telemetry_overhead_regression`. Verdict rides the single
+    `bench_all_metrics` tail line and the exit code."""
+    all_metrics: dict[str, dict] = {}
+
+    def emit_json(obj):
+        print(json.dumps(obj), flush=True)
+        all_metrics[obj["metric"]] = obj
+
+    costs = _measure_devobs_costs()
+    per_interval_us = (
+        8 * costs["armed_call_us"]
+        + 6 * costs["transfer_us"]
+        + 2 * costs["mem_add_us"]
+        + costs["interval_tick_ns"] / 1000.0
+        + costs["timeline_slice_us"]
+    )
+    overhead_pct = (
+        per_interval_us / (TRACE_INTERVAL_BUDGET_MS * 1000.0) * 100.0
+    )
+    emit_json(
+        {
+            "metric": "device_telemetry_costs",
+            "value": round(per_interval_us, 3),
+            "unit": "us per 100k-ticket interval",
+            **{k: round(v, 4) for k, v in costs.items()},
+        }
+    )
+    workloads = _devobs_workloads_phase()
+    emit_json(
+        {
+            "metric": "device_telemetry_workloads",
+            "value": workloads["kernels_active"],
+            "unit": "kernels with recorded calls",
+            **{
+                k: v
+                for k, v in workloads.items()
+                if k != "kernels_active"
+            },
+            "note": (
+                "one matchmaker interval + one leaderboard flush/rank"
+                " on the same process through the armed plane; the"
+                " memory_by_owner split is the measured shared-mesh"
+                " HBM occupancy per workload"
+            ),
+        }
+    )
+    reasons, regression = device_telemetry_overhead_regression(
+        overhead_pct,
+        kernels_n=workloads["kernels_active"],
+        compiles_total=workloads["compiles_total"],
+        memory_owners=len(workloads["memory_by_owner"]),
+    )
+    emit_json(
+        {
+            "metric": "device_telemetry_overhead_pct",
+            "value": round(overhead_pct, 5),
+            "unit": f"% of a {TRACE_INTERVAL_BUDGET_MS}ms interval",
+            "note": (
+                "always-on device telemetry on the 100k-ticket"
+                " interval path: kernel clock wraps + transfer ticks +"
+                " dispatch-ring mem adds + warmup tick + ledger"
+                " timeline slice"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "device_telemetry_overhead_regression",
+            "value": int(regression),
+            "unit": "bool",
+            "regression": regression,
+            "reasons": reasons,
+        }
+    )
+    print(
+        json.dumps(
+            {"metric": "bench_all_metrics", "metrics": all_metrics}
+        ),
+        flush=True,
+    )
+    if regression:
+        print(
+            "FAIL: device telemetry regression: "
+            + "; ".join(reasons),
+            file=sys.stderr,
+            flush=True,
+        )
+    return 1 if regression else 0
+
+
 # ------------------------------------------------------------------ chaos
 
 CHAOS_POOL = int(os.environ.get("BENCH_CHAOS_POOL", 1024))
@@ -2845,6 +3086,14 @@ def main():
         # writes its verdict into the same single bench_all_metrics
         # tail line a driver keeps.
         return run_overload_main()
+    if "--device-obs" in sys.argv[1:] or os.environ.get(
+        "BENCH_DEVICE_OBS"
+    ):
+        # Device-telemetry-only run: the always-on compile-watch /
+        # kernel-clock / HBM-ledger cost proof + the two-workload
+        # non-empty-telemetry leg, gated by the named
+        # device_telemetry_overhead_regression.
+        return run_device_obs_main()
     if "--trace-overhead" in sys.argv[1:] or os.environ.get(
         "BENCH_TRACE_OVERHEAD"
     ):

@@ -378,6 +378,28 @@ class TracingConfig:
 
 
 @dataclass
+class DevObsConfig:
+    """Device telemetry plane (devobs.py): compile-watch, per-kernel
+    wall clocks, the HBM ownership ledger, and the console's on-demand
+    profiler capture. Defaults are the armed production posture — the
+    plane is always-on (bench.py --device-obs proves it under 1% of
+    the interval budget); `enabled=False` reduces every hook to one
+    attribute read."""
+
+    enabled: bool = True
+    # Interval ticks before the compile warmup window closes: compiles
+    # inside it are expected (first shapes, prewarm chains); after it,
+    # a hot-path compile WARNs and ticks xla_recompiles_total{kernel}.
+    warmup_intervals: int = 3
+    # Bounded kernel-event timeline depth (console last-interval view;
+    # delivery-ledger device phase chains slice it by wall window).
+    timeline_depth: int = 256
+    # Upper bound on one console-triggered jax.profiler capture; the
+    # endpoint clamps requested durations here (output under data_dir).
+    capture_max_ms: int = 10_000
+
+
+@dataclass
 class RecoveryConfig:
     """Crash-recovery plane (recovery.py): the durable ticket journal
     (append-only, LSN-ordered, drained through the group-commit write
@@ -442,6 +464,7 @@ class Config:
     overload: OverloadConfig = field(default_factory=OverloadConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
+    devobs: DevObsConfig = field(default_factory=DevObsConfig)
 
     @property
     def node(self) -> str:
@@ -482,6 +505,13 @@ class Config:
             )
         if not (0.0 < self.tracing.slo_target < 1.0):
             warnings.append("tracing.slo_target should be in (0, 1)")
+        if self.devobs.warmup_intervals < 0:
+            raise ValueError("devobs.warmup_intervals must be >= 0")
+        if self.devobs.capture_max_ms > 60_000:
+            warnings.append(
+                "devobs.capture_max_ms over 60s — a console-triggered"
+                " profiler capture of that length can fill data_dir"
+            )
         if self.recovery.checkpoint_interval_sec < 1:
             raise ValueError(
                 "recovery.checkpoint_interval_sec must be >= 1"
@@ -675,6 +705,7 @@ __all__ = [
     "OverloadConfig",
     "TracingConfig",
     "RecoveryConfig",
+    "DevObsConfig",
     "load_config",
     "parse_args",
     "config_to_dict",

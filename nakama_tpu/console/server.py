@@ -90,6 +90,9 @@ class ConsoleServer:
         )
         r.add_get("/v2/console/match", self._h_match_list)
         r.add_get("/v2/console/matchmaker", self._h_matchmaker)
+        r.add_get("/v2/console/device", self._h_device)
+        r.add_post("/v2/console/device/capture", self._h_device_capture)
+        self._capture_busy = False
         r.add_get("/v2/console/match/{id}/state", self._h_match_state)
         r.add_get("/v2/console/leaderboard", self._h_leaderboard_list)
         r.add_get(
@@ -779,6 +782,89 @@ class ConsoleServer:
                     else {}
                 ),
             }
+        )
+
+    async def _h_device(self, request: web.Request):
+        """Device telemetry dashboard (devobs.py): per-kernel clocks +
+        compile-watch counters, memory by owner with the backend
+        cross-check, transfer counters per call site, the mesh
+        occupancy view, and the recent kernel-event timeline — "where
+        did this interval's device time go" off one endpoint."""
+        self._auth(request)
+        from ..devobs import DEVOBS
+        from ..parallel.mesh import describe_mesh
+
+        backend = self.server.matchmaker.backend
+        mesh = getattr(backend, "_mesh", None)
+        pool = getattr(backend, "pool", None)
+        try:
+            n = min(256, max(1, int(request.query.get("n", 64))))
+        except (TypeError, ValueError):
+            return _err(400, "n must be an integer")
+        return web.json_response(
+            {
+                **DEVOBS.stats(),
+                "mesh": describe_mesh(
+                    mesh,
+                    pool_capacity=getattr(pool, "capacity", 0),
+                ),
+                "timeline": DEVOBS.recent_timeline(n),
+            }
+        )
+
+    async def _h_device_capture(self, request: web.Request):
+        """On-demand bounded jax.profiler capture — the console wiring
+        Tracing.device_trace's docstring promised. One capture at a
+        time; duration clamped to config.devobs.capture_max_ms; output
+        lands under data_dir/device_captures (view with
+        `tensorboard --logdir <path>` / xprof)."""
+        self._auth(request, write=True)
+        import asyncio
+        import os
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        try:
+            duration_ms = int(body.get("duration_ms", 1000))
+        except (TypeError, ValueError):
+            return _err(400, "duration_ms must be an integer")
+        cap = self.config.devobs.capture_max_ms
+        duration_ms = min(max(50, duration_ms), cap)
+        if self._capture_busy:
+            return _err(409, "a device capture is already running")
+        tracing = getattr(
+            self.server.matchmaker.backend, "tracing", None
+        )
+        if tracing is None or not hasattr(tracing, "device_trace"):
+            from ..tracing import Tracing
+
+            tracing = Tracing(logger=self.logger)
+        out_dir = os.path.join(
+            self.config.data_dir,
+            "device_captures",
+            time.strftime("%Y%m%d-%H%M%S"),
+        )
+        os.makedirs(out_dir, exist_ok=True)
+        self._capture_busy = True
+        try:
+            with tracing.device_trace(out_dir):
+                # The profiler records process-wide: whatever device
+                # work the workloads run inside this bounded window is
+                # the capture.
+                await asyncio.sleep(duration_ms / 1000.0)
+        except Exception as e:
+            return _err(503, f"device capture failed: {e}")
+        finally:
+            self._capture_busy = False
+        self.logger.info(
+            "device capture written",
+            path=out_dir,
+            duration_ms=duration_ms,
+        )
+        return web.json_response(
+            {"path": out_dir, "duration_ms": duration_ms}
         )
 
     async def _h_match_state(self, request: web.Request):

@@ -92,6 +92,8 @@ const R = {
   matchList:        ['GET',    '/v2/console/match'],
   matchState:       ['GET',    '/v2/console/match/{id}/state'],
   matchmaker:       ['GET',    '/v2/console/matchmaker'],
+  device:           ['GET',    '/v2/console/device'],
+  deviceCapture:    ['POST',   '/v2/console/device/capture'],
   lbList:           ['GET',    '/v2/console/leaderboard'],
   lbDevice:         ['GET',    '/v2/console/leaderboard/device'],
   lbGet:            ['GET',    '/v2/console/leaderboard/{id}/detail'],
@@ -544,6 +546,36 @@ const TABS = {
   matchmaker: async (el) => {
     const d = await call('matchmaker');
     el.appendChild($(jpre(d)));
+  },
+  device: async (el) => {
+    // Device telemetry: kernel clocks + compile-watch, HBM ledger by
+    // owner, mesh occupancy, recent kernel timeline, and the bounded
+    // on-demand profiler capture.
+    const d = await call('device');
+    const rows = (d.kernels || []).map(k =>
+      `<tr><td>${esc(k.kernel)}</td><td>${esc(k.calls)}</td>
+       <td>${esc(k.p50_ms)}</td><td>${esc(k.p99_ms)}</td>
+       <td>${esc(k.ema_ms)}</td><td>${esc(k.compiles)}</td>
+       <td>${esc(k.recompiles)}</td></tr>`).join('');
+    el.appendChild($(`<div class="bar">
+        <button id="cap">Capture 1s profile</button><span id="r"></span>
+      </div>
+      <h4>kernels (warmed=${esc((d.warmup || {}).warmed)})</h4>
+      <table><tr><th>kernel</th><th>calls</th><th>p50ms</th>
+      <th>p99ms</th><th>emams</th><th>compiles</th><th>recompiles</th>
+      </tr>${rows}</table>
+      <h4>memory by owner</h4>${jpre(d.memory || {})}
+      <h4>transfers</h4>${jpre(d.transfers || [])}
+      <h4>mesh</h4>${jpre(d.mesh || {})}
+      <h4>timeline</h4>${jpre(d.timeline || [])}`));
+    el.querySelector('#cap').onclick = report(
+      el.querySelector('#r'),
+      async () => {
+        const out = await call('deviceCapture', {}, {
+          duration_ms: 1000,
+        });
+        return `capture written to ${out.path}`;
+      });
   },
   traces: async (el) => {
     // Tail-sampled request traces: summary table → one-click span

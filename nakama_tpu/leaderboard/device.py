@@ -42,6 +42,7 @@ import numpy as np
 
 from .. import faults
 from .. import tracing as trace_api
+from ..devobs import DEVOBS
 from ..faults import HALF_OPEN, STATE_CODE, CircuitBreaker, classify_exception
 from ..overload import current_deadline
 
@@ -187,6 +188,14 @@ class DeviceRankEngine:
         self._boards: dict[tuple[str, float], _DeviceBoard] = {}
         self._tpu_mod = None
         self.disabled = False
+        # Device telemetry: name this workload's jit entry points up
+        # front (console lists them before the first flush); the
+        # compile-watch listener itself installs in _tpu() once jax is
+        # actually imported — host-only deployments never pay it.
+        for kernel in (
+            "leaderboard.flush", "leaderboard.rank", "leaderboard.sweep",
+        ):
+            DEVOBS.register(kernel)
         # Ledger counters (console / tests / bench).
         self.device_reads = 0
         self.fallbacks = 0
@@ -228,7 +237,21 @@ class DeviceRankEngine:
             from . import tpu as tpu_mod
 
             self._tpu_mod = tpu_mod
+            # jax is importable on this host: (re-)register so the
+            # process-wide compile-watch listener installs even when
+            # this engine is the first device workload in the process.
+            DEVOBS.register("leaderboard.flush")
         return self._tpu_mod
+
+    def _update_mem(self) -> None:
+        """Refresh the HBM ledger's board row: every adopted board's
+        live device tensors (scatter target + sorted copy + perm)."""
+        total = 0
+        for b in self._boards.values():
+            for arr in (b.device_keys, b.sorted_keys, b.perm):
+                if arr is not None:
+                    total += int(getattr(arr, "nbytes", 0))
+        DEVOBS.mem_set("leaderboard.boards", total)
 
     def _deadline_blocks(self) -> bool:
         """PR 5 short-circuit: with no budget left for a device
@@ -302,6 +325,7 @@ class DeviceRankEngine:
     def delete_board(self, board_id: str) -> None:
         for key in [k for k in self._boards if k[0] == board_id]:
             del self._boards[key]
+        self._update_mem()
 
     def trim_expired(self, now: float) -> int:
         gone = [
@@ -309,10 +333,13 @@ class DeviceRankEngine:
         ]
         for k in gone:
             del self._boards[k]
+        if gone:
+            self._update_mem()
         return len(gone)
 
     def clear_all(self) -> None:
         self._boards.clear()
+        self._update_mem()
 
     # ------------------------------------------------------------------ flush
 
@@ -353,26 +380,35 @@ class DeviceRankEngine:
                 else time.perf_counter() - b.dirty_since
             )
             try:
-                if b.device_keys is None or b.full_upload:
-                    b.device_keys = jnp.asarray(b.keys32())
-                    b.full_upload = False
-                elif b.dirty:
-                    idx = np.fromiter(
-                        b.dirty, dtype=np.int32, count=len(b.dirty)
-                    )
-                    u = len(idx)
-                    up = min(tpu.pad_pow2(u), b.capacity)
-                    pidx = np.empty(up, dtype=np.int32)
-                    pidx[:u] = idx[:up]
-                    pidx[u:] = idx[u - 1]
-                    rows = b.keys[pidx].astype(np.int32)
-                    b.device_keys = tpu.scatter_keys(
-                        b.device_keys, jnp.asarray(pidx),
-                        jnp.asarray(rows),
-                    )
-                skeys, perm = tpu.sort_boards(b.device_keys[None])
-                b.sorted_keys = skeys[0]
-                b.perm = perm[0]
+                with DEVOBS.device_call("leaderboard.flush"):
+                    if b.device_keys is None or b.full_upload:
+                        full = b.keys32()
+                        b.device_keys = jnp.asarray(full)
+                        b.full_upload = False
+                        DEVOBS.transfer(
+                            "leaderboard.flush", "h2d", int(full.nbytes)
+                        )
+                    elif b.dirty:
+                        idx = np.fromiter(
+                            b.dirty, dtype=np.int32, count=len(b.dirty)
+                        )
+                        u = len(idx)
+                        up = min(tpu.pad_pow2(u), b.capacity)
+                        pidx = np.empty(up, dtype=np.int32)
+                        pidx[:u] = idx[:up]
+                        pidx[u:] = idx[u - 1]
+                        rows = b.keys[pidx].astype(np.int32)
+                        b.device_keys = tpu.scatter_keys(
+                            b.device_keys, jnp.asarray(pidx),
+                            jnp.asarray(rows),
+                        )
+                        DEVOBS.transfer(
+                            "leaderboard.flush", "h2d",
+                            int(pidx.nbytes) + int(rows.nbytes),
+                        )
+                    skeys, perm = tpu.sort_boards(b.device_keys[None])
+                    b.sorted_keys = skeys[0]
+                    b.perm = perm[0]
             except Exception:
                 # The donated scatter target may be dead: rebuild from
                 # the host mirror on the next (post-breaker) attempt.
@@ -394,6 +430,7 @@ class DeviceRankEngine:
                 b.free.extend(b.pending_free)
                 b.pending_free = []
             self.flushes += 1
+            self._update_mem()
             if lag is not None:
                 self.last_flush_lag_s = lag
                 if self.metrics is not None:
@@ -508,11 +545,18 @@ class DeviceRankEngine:
                 q[: len(q_keys)] = np.asarray(
                     [k[:3] for k in q_keys], dtype=np.int64
                 ).astype(np.int32)
-                ranks = np.asarray(
-                    tpu.lex_ranks(
-                        b.sorted_keys, jnp.asarray(q),
-                        tpu.n_search_iters(b.capacity),
+                with DEVOBS.device_call("leaderboard.rank"):
+                    ranks = np.asarray(
+                        tpu.lex_ranks(
+                            b.sorted_keys, jnp.asarray(q),
+                            tpu.n_search_iters(b.capacity),
+                        )
                     )
+                DEVOBS.transfer(
+                    "leaderboard.rank", "h2d", int(q.nbytes)
+                )
+                DEVOBS.transfer(
+                    "leaderboard.rank", "d2h", int(ranks.nbytes)
                 )
                 for j, i in enumerate(q_pos):
                     out[i] = int(ranks[j])
@@ -553,8 +597,12 @@ class DeviceRankEngine:
             eff = min(limit, n - start)
             lp = min(tpu.pad_pow2(eff), b.capacity)
             adj = min(start, b.capacity - lp)
-            slots = np.asarray(
-                tpu.window_slots(b.perm, jnp.int32(adj), lp)
+            with DEVOBS.device_call("leaderboard.rank"):
+                slots = np.asarray(
+                    tpu.window_slots(b.perm, jnp.int32(adj), lp)
+                )
+            DEVOBS.transfer(
+                "leaderboard.window", "d2h", int(slots.nbytes)
             )
             off = start - adj
             out = []
@@ -621,8 +669,15 @@ class DeviceRankEngine:
                 stacked[i] = b.keys32()
             for i in range(nb, bp):
                 stacked[i] = stacked[nb - 1]
-            _, perm = tpu.sort_boards(jnp.asarray(stacked))
-            perm = np.asarray(perm)
+            with DEVOBS.device_call("leaderboard.sweep"):
+                _, perm = tpu.sort_boards(jnp.asarray(stacked))
+                perm = np.asarray(perm)
+            DEVOBS.transfer(
+                "leaderboard.sweep", "h2d", int(stacked.nbytes)
+            )
+            DEVOBS.transfer(
+                "leaderboard.sweep", "d2h", int(perm.nbytes)
+            )
             out = {}
             for i, b in enumerate(group):
                 desc = b.sort_order == 1
@@ -718,6 +773,14 @@ class DeviceRankEngine:
                 "dirty": len(b.dirty),
                 "flushed": b.sorted_valid,
                 "host_only": b.host_only,
+                # Projected per-board HBM once flushed (tpu.py's
+                # formula; the live total is the telemetry plane's
+                # leaderboard.boards ledger row).
+                "device_bytes": (
+                    self._tpu_mod.board_device_bytes(b.capacity)
+                    if self._tpu_mod is not None and b.sorted_valid
+                    else 0
+                ),
             })
         return {
             "enabled": not self.disabled,

@@ -111,6 +111,15 @@ def window_slots(perm: jnp.ndarray, start: jnp.ndarray, limit: int):
     return jax.lax.dynamic_slice_in_dim(perm, start, limit)
 
 
+def board_device_bytes(capacity: int) -> int:
+    """HBM footprint of one fully-flushed board at `capacity` slots:
+    the int32 scatter target [C, 3] + the sorted copy [C, 3] + the
+    rank permutation [C] — the per-board figure the telemetry plane's
+    `leaderboard.boards` ledger row sums (devobs.py) and the console
+    shows per adopted board."""
+    return int(capacity) * (12 + 12 + 4)
+
+
 def pad_pow2(n: int, floor: int = 8) -> int:
     """Pad `n` up to a power-of-two bucket (>= floor) so each kernel
     compiles once per bucket, not once per distinct size."""

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..devobs import DEVOBS
 from .compile import SOP_ALL, SOP_NUM_RANGE, SOP_STR_EQ, SOP_UNUSED
 
 NEG_INF = np.float32(-np.inf)
@@ -166,6 +167,13 @@ class PoolBuffer:
             self.device = jax.tree.map(jnp.asarray, host)
             self._scatter = _scatter
             self._invalidate = _invalidate
+        # HBM ledger: the pool columns are the process's largest
+        # device-resident allocation — one owner row, refreshed on
+        # load() (capacity is fixed, so alloc time is the whole story).
+        DEVOBS.mem_set(
+            "matchmaker.pool",
+            sum(int(v.nbytes) for v in self.device.values()),
+        )
         # Slot allocation lives in the caller's SlotStore (store.py) so
         # host metadata, reverse maps, and device rows share one slot
         # space; this buffer only stages device-row updates by slot.
@@ -242,13 +250,15 @@ class PoolBuffer:
         side validity (a dead row's stale contents are never scored —
         FLAG_VALID aside, the store's alive mask rules dispatch)."""
         hw = self.high_water
-        return {
-            "high_water": hw,
-            "columns": {
-                k: np.ascontiguousarray(np.asarray(v)[:hw])
-                for k, v in self.device.items()
-            },
+        columns = {
+            k: np.ascontiguousarray(np.asarray(v)[:hw])
+            for k, v in self.device.items()
         }
+        DEVOBS.transfer(
+            "pool.snapshot", "d2h",
+            sum(int(v.nbytes) for v in columns.values()),
+        )
+        return {"high_water": hw, "columns": columns}
 
     def load(self, snap: dict) -> None:
         """Warm-restart restore: rebuild the device-resident pool from a
@@ -270,6 +280,9 @@ class PoolBuffer:
             }
         else:
             self.device = jax.tree.map(jnp.asarray, host)
+        total = sum(int(v.nbytes) for v in self.device.values())
+        DEVOBS.transfer("pool.load", "h2d", total)
+        DEVOBS.mem_set("matchmaker.pool", total)
         self.high_water = hw
         # Staging state resets with the buffers it described.
         self._stage_slots[:] = -1
@@ -298,23 +311,31 @@ class PoolBuffer:
 
         def _warm():
             try:
-                for u_pad in (max(256, self.flush_chunk // 4),
-                              self.flush_chunk):
-                    # Scratch pool of identical shapes: the jit cache keys
-                    # on abstract signatures, so the compile carries over
-                    # to the real pool while self.device (donated by real
-                    # flushes) is never touched off-thread.
-                    scratch = {
-                        k: jnp.zeros(shp, dt)
-                        for k, (shp, dt) in shapes.items()
-                    }
-                    idx = jnp.zeros(u_pad, dtype=jnp.int32)
-                    rows = {
-                        k: jnp.zeros((u_pad,) + shp[1:], dt)
-                        for k, (shp, dt) in shapes.items()
-                    }
-                    out = scatter(scratch, idx, rows)
-                    jax.block_until_ready(out)
+                # Compile-watch: the whole prewarm body (the scratch
+                # jnp.zeros fills compile tiny programs too) attributes
+                # as EXPECTED compiles — prewarming is the cure for
+                # hot-path recompiles, never flagged as one.
+                with DEVOBS.device_call(
+                    "matchmaker.scatter", expect_compile=True
+                ):
+                    for u_pad in (max(256, self.flush_chunk // 4),
+                                  self.flush_chunk):
+                        # Scratch pool of identical shapes: the jit
+                        # cache keys on abstract signatures, so the
+                        # compile carries over to the real pool while
+                        # self.device (donated by real flushes) is
+                        # never touched off-thread.
+                        scratch = {
+                            k: jnp.zeros(shp, dt)
+                            for k, (shp, dt) in shapes.items()
+                        }
+                        idx = jnp.zeros(u_pad, dtype=jnp.int32)
+                        rows = {
+                            k: jnp.zeros((u_pad,) + shp[1:], dt)
+                            for k, (shp, dt) in shapes.items()
+                        }
+                        out = scatter(scratch, idx, rows)
+                        jax.block_until_ready(out)
             except Exception as e:
                 # One-shot: a persistent failure (device OOM on the
                 # scratch clone) must not silently re-spawn an allocating
@@ -366,7 +387,11 @@ class PoolBuffer:
             idx = np.empty(u_pad, dtype=np.int32)
             idx[:u] = rm
             idx[u:] = rm[-1]
-            self.device = self._invalidate(self.device, jnp.asarray(idx))
+            with DEVOBS.device_call("matchmaker.scatter"):
+                self.device = self._invalidate(
+                    self.device, jnp.asarray(idx)
+                )
+            DEVOBS.transfer("pool.flush", "h2d", int(idx.nbytes))
 
         n = self._stage_n
         if n:
@@ -395,10 +420,16 @@ class PoolBuffer:
                     padded[:u] = arr
                     padded[u:] = arr[-1]
                     stacked[k] = padded
-                self.device = self._scatter(
-                    self.device,
-                    jnp.asarray(idx),
-                    jax.tree.map(jnp.asarray, stacked),
+                with DEVOBS.device_call("matchmaker.scatter"):
+                    self.device = self._scatter(
+                        self.device,
+                        jnp.asarray(idx),
+                        jax.tree.map(jnp.asarray, stacked),
+                    )
+                DEVOBS.transfer(
+                    "pool.flush", "h2d",
+                    int(idx.nbytes)
+                    + sum(int(v.nbytes) for v in stacked.values()),
                 )
                 if self.on_flush is not None:
                     self.on_flush(stacked)

@@ -37,6 +37,7 @@ from ..logger import Logger
 from ..metrics import Metrics
 from .. import faults, native
 from .. import tracing as trace_api
+from ..devobs import DEVOBS
 from ..faults import CLOSED, HALF_OPEN, STATE_CODE, CircuitBreaker, classify_exception
 from .compile import (
     FULL_HI,
@@ -304,6 +305,17 @@ class TpuBackend:
         # chain when one call collects several cohorts. Transient —
         # replaced every call, never retained past it.
         self._accepted_cohorts: list[tuple[dict, np.ndarray]] = []
+        # Device telemetry plane: the named jit entry points this
+        # backend drives. Registration installs the process-wide
+        # compile-watch listener (jax is imported by now), so every
+        # XLA compile from here on is attributed and counted.
+        for kernel in (
+            "matchmaker.scatter",
+            "matchmaker.score",
+            "matchmaker.assign",
+            "matchmaker.fetch",
+        ):
+            DEVOBS.register(kernel)
 
     def attach(self, store):
         """Bind the LocalMatchmaker's SlotStore: one slot space shared by
@@ -653,6 +665,10 @@ class TpuBackend:
         meta = self.meta
         pipelined = self.config.interval_pipelining
         self._accepted_cohorts = []
+        # Device telemetry: one warmup tick per interval — after
+        # config.devobs.warmup_intervals of these, a hot-path compile
+        # is an unexpected recompile (WARN + counter + span event).
+        DEVOBS.interval_tick()
         # Backstop reclamation first: wedged/orphaned in-flight claims
         # must release BEFORE this interval filters its dispatch by the
         # in-flight mask, or a stranded slot stays invisible forever.
@@ -749,6 +765,12 @@ class TpuBackend:
                 device_slots, device_last
             )
             pending = None
+            import time as _time
+
+            # Device-timeline window opens BEFORE the flush: the
+            # cohort's ledger entry slices the kernel-event timeline
+            # from here, so its scatter phase reads off the record too.
+            t_window_wall = _time.time()
             # Each dispatched cohort gets its own trace: root span over
             # flush+dispatch, held open until accept/abandon closes it
             # with the stage spans. A dispatch failure makes it an
@@ -778,6 +800,7 @@ class TpuBackend:
                     self._note_backend_failure("dispatch", e, crumb)
                     react_parts.append(device_slots.astype(np.int32))
                 else:
+                    pending[1]["t_window_wall"] = t_window_wall
                     if probe_pending:
                         # Tag the half-open probe cohort: only ITS successful
                         # collection may close the breaker (_accept_work) — a
@@ -1225,6 +1248,17 @@ class TpuBackend:
             ledger["accept_lag_s"] = round(
                 _time.perf_counter() - t_disp, 3
             )
+            # Device phases on the same record as the host stage chain:
+            # kernel events between the cohort's flush and now (shared-
+            # mesh neighbors — leaderboard flushes — land here too,
+            # which is the point: contention reads off one record).
+            t_w0 = holder.get("t_window_wall") or holder.get(
+                "t_dispatch_wall"
+            )
+            if t_w0 is not None:
+                ledger["device_timeline"] = DEVOBS.timeline_between(
+                    t_w0, _time.time()
+                )
             tctx = holder.get("trace")
             if tctx is not None:
                 # The ledger entry names its cohort trace, so a ticket
@@ -1538,30 +1572,33 @@ class TpuBackend:
             )
 
             grid_lo, grid_inv = self._grid_params()
-            cand_dev = topk_candidates_big(
-                self.pool.device,
-                pad_to(slots, a_pad, -1),
-                grid_lo,
-                grid_inv,
-                fn=self.fn,
-                fs=self.fs,
-                n_cols=n_cols,
-                # Pairs keep the full candidate width: coverage is set by
-                # list DIVERSITY, not handshake rounds — capping k to 16
-                # measured ~5% unmatched leftovers (overlapping lists
-                # exhaust under contention; rounds can't recover).
-                k=self.k,
-                rev=rev,
-                with_should=with_should,
-                with_embedding=with_embedding,
-                bm=bm,
-                bn=bn,
-                interpret=self._interpret,
-                emb_scale=self.config.emb_score_scale,
-                # The handshake needs eligible candidates, not the exact
-                # (-score, created) order: skip stage 2's second sort.
-                order_exact=not use_pairs,
-            )
+            with DEVOBS.device_call("matchmaker.score"):
+                cand_dev = topk_candidates_big(
+                    self.pool.device,
+                    pad_to(slots, a_pad, -1),
+                    grid_lo,
+                    grid_inv,
+                    fn=self.fn,
+                    fs=self.fs,
+                    n_cols=n_cols,
+                    # Pairs keep the full candidate width: coverage is
+                    # set by list DIVERSITY, not handshake rounds —
+                    # capping k to 16 measured ~5% unmatched leftovers
+                    # (overlapping lists exhaust under contention;
+                    # rounds can't recover).
+                    k=self.k,
+                    rev=rev,
+                    with_should=with_should,
+                    with_embedding=with_embedding,
+                    bm=bm,
+                    bn=bn,
+                    interpret=self._interpret,
+                    emb_scale=self.config.emb_score_scale,
+                    # The handshake needs eligible candidates, not the
+                    # exact (-score, created) order: skip stage 2's
+                    # second sort.
+                    order_exact=not use_pairs,
+                )
             if use_pairs:
                 return self._pairs_dispatch(cand_dev, slots, a_pad, last, rev)
             return self._bg_asm("big", (cand_dev,), slots, last, rev)
@@ -1574,18 +1611,19 @@ class TpuBackend:
             self.col_block * _pow2_blocks(col_blocks),
             self.pool.capacity,
         )
-        scores, cand = topk_candidates(
-            self.pool.device,
-            pad_to(slots, a_pad, -1),
-            k=min(self.k, n_cols),
-            br=self.row_block,
-            bc=self.col_block,
-            rev=rev,
-            n_cols=n_cols,
-            with_should=with_should,
-            with_embedding=with_embedding,
-            created_base=np.int32(self._created_base),
-        )
+        with DEVOBS.device_call("matchmaker.score"):
+            scores, cand = topk_candidates(
+                self.pool.device,
+                pad_to(slots, a_pad, -1),
+                k=min(self.k, n_cols),
+                br=self.row_block,
+                bc=self.col_block,
+                rev=rev,
+                n_cols=n_cols,
+                with_should=with_should,
+                with_embedding=with_embedding,
+                created_base=np.int32(self._created_base),
+            )
         return self._bg_asm("small", (scores, cand), slots, last, rev)
 
     def _use_pairs(self) -> bool:
@@ -1609,11 +1647,12 @@ class TpuBackend:
 
         from .device2 import pair_partners
 
-        partner_dev, prop_dev = pair_partners(
-            cand_dev,
-            jnp.asarray(pad_to(slots, a_pad, -1)),
-            cap=self.pool.capacity,
-        )
+        with DEVOBS.device_call("matchmaker.assign"):
+            partner_dev, prop_dev = pair_partners(
+                cand_dev,
+                jnp.asarray(pad_to(slots, a_pad, -1)),
+                cap=self.pool.capacity,
+            )
         return self._bg_asm(
             "pairs", (partner_dev, prop_dev), slots, last, rev
         )
@@ -1657,6 +1696,23 @@ class TpuBackend:
             "deadline": t_disp + max(1.0, float(self.config.interval_sec)),
         }
         n_rows = len(slots)
+        # HBM ledger: the dispatch ring — candidate/partner tensors
+        # alive on device between kernel launch and their D2H fetch
+        # (transient, but at 100k actives it is tens of MB of HBM the
+        # pool columns don't explain). Released when the fetch lands.
+        dispatch_bytes = sum(
+            int(getattr(a, "nbytes", 0)) for a in dev_arrays
+        )
+        DEVOBS.mem_add("matchmaker.dispatch", dispatch_bytes)
+
+        def _fetch(arr):
+            # The blocking D2H read: compute + transfer tail lands on
+            # this clock (the async score call's clock only saw
+            # dispatch + compile time).
+            with DEVOBS.device_call("matchmaker.fetch"):
+                host = np.ascontiguousarray(np.asarray(arr))
+            DEVOBS.transfer("cohort.fetch", "d2h", int(host.nbytes))
+            return host
 
         def _run(out=holder):
             try:
@@ -1665,12 +1721,8 @@ class TpuBackend:
                 # reclamation + breaker path.
                 faults.fire("device.collect")
                 if kind == "pairs":
-                    partner = np.ascontiguousarray(
-                        np.asarray(dev_arrays[0])
-                    )[:n_rows]
-                    proposer = np.ascontiguousarray(
-                        np.asarray(dev_arrays[1])
-                    )[:n_rows]
+                    partner = _fetch(dev_arrays[0])[:n_rows]
+                    proposer = _fetch(dev_arrays[1])[:n_rows]
                     out["t_fetched"] = _time.perf_counter()
                     out["asm"] = self._assemble_pairs(
                         slots, partner, proposer, rev
@@ -1680,23 +1732,18 @@ class TpuBackend:
                     # Already exactly ordered by (-score, created) on
                     # device; a row slice of the contiguous fetch stays
                     # C-contiguous.
-                    cand_np = np.ascontiguousarray(
-                        np.asarray(dev_arrays[0])
-                    )[:n_rows]
+                    cand_np = _fetch(dev_arrays[0])[:n_rows]
                     out["t_fetched"] = _time.perf_counter()
                 else:
-                    scores_np = np.ascontiguousarray(
-                        np.asarray(dev_arrays[0])
-                    )[:n_rows]
-                    cand_np = np.ascontiguousarray(
-                        np.asarray(dev_arrays[1])
-                    )[:n_rows]
+                    scores_np = _fetch(dev_arrays[0])[:n_rows]
+                    cand_np = _fetch(dev_arrays[1])[:n_rows]
                     out["t_fetched"] = _time.perf_counter()
                     cand_np = self._order_small(scores_np, cand_np)
                 out["asm"] = self._assemble(slots, last, cand_np, rev)
             except Exception as e:  # surfaced at collect
                 out["err"] = e
             finally:
+                DEVOBS.mem_add("matchmaker.dispatch", -dispatch_bytes)
                 out["t_ready"] = _time.perf_counter()
                 # Completion signal LAST (after the ready stamp, so a
                 # woken collector always sees a finished cohort). A
@@ -1852,23 +1899,24 @@ class TpuBackend:
             bm, bn = self.big_row_block, self.big_col_block
             a_pad = _pow2_blocks(-(-len(slots) // bm)) * bm
             grid_lo, grid_inv = self._grid_params()
-            cand_dev = topk_candidates_big_sharded(
-                self.pool.device,
-                pad_to(slots, a_pad, -1),
-                grid_lo,
-                grid_inv,
-                mesh=self._mesh,
-                fn=self.fn,
-                fs=self.fs,
-                k=self.k,
-                rev=rev,
-                with_should=with_should,
-                with_embedding=with_embedding,
-                bm=bm,
-                bn=bn,
-                interpret=self._interpret,
-                emb_scale=self.config.emb_score_scale,
-            )
+            with DEVOBS.device_call("matchmaker.score"):
+                cand_dev = topk_candidates_big_sharded(
+                    self.pool.device,
+                    pad_to(slots, a_pad, -1),
+                    grid_lo,
+                    grid_inv,
+                    mesh=self._mesh,
+                    fn=self.fn,
+                    fs=self.fs,
+                    k=self.k,
+                    rev=rev,
+                    with_should=with_should,
+                    with_embedding=with_embedding,
+                    bm=bm,
+                    bn=bn,
+                    interpret=self._interpret,
+                    emb_scale=self.config.emb_score_scale,
+                )
             if self._use_pairs():
                 # Works on the ICI-merged candidate lists exactly as on
                 # one chip (VERDICT r4 #8).
@@ -1883,17 +1931,18 @@ class TpuBackend:
         rows = dict(self._gather_rows(self.pool.device, safe))
         rows["_valid"] = jnp.asarray((pad_slots >= 0).astype(np.int32))
         rows["_slot"] = jnp.asarray(pad_slots.astype(np.int32))
-        scores, cand = sharded_topk_rows(
-            self._mesh,
-            self.pool.device,
-            rows,
-            k=min(self.k, self.pool.capacity),
-            br=br,
-            bc=self.col_block,
-            rev=rev,
-            with_should=with_should,
-            with_embedding=with_embedding,
-        )
+        with DEVOBS.device_call("matchmaker.score"):
+            scores, cand = sharded_topk_rows(
+                self._mesh,
+                self.pool.device,
+                rows,
+                k=min(self.k, self.pool.capacity),
+                br=br,
+                bc=self.col_block,
+                rev=rev,
+                with_should=with_should,
+                with_embedding=with_embedding,
+            )
         return self._bg_asm("small", (scores, cand), slots, last, rev)
 
     def _prewarm_row_bucket(
@@ -1937,39 +1986,53 @@ class TpuBackend:
         def _warm():
             import jax.numpy as jnp
 
-            scratch = {
-                k: jnp.zeros(shp, dt) for k, (shp, dt) in shapes.items()
-            }
+            # Scratch fills compile tiny programs of their own: keep
+            # the whole prewarm body inside an expected-compile context.
+            with DEVOBS.device_call(
+                "matchmaker.score", expect_compile=True
+            ):
+                scratch = {
+                    k: jnp.zeros(shp, dt)
+                    for k, (shp, dt) in shapes.items()
+                }
             for size in sizes:
                 try:
-                    warm_cand = topk_candidates_big(
-                        scratch,
-                        np.full(size, -1, np.int32),
-                        grid_lo,
-                        grid_inv,
-                        fn=self.fn,
-                        fs=self.fs,
-                        n_cols=n_cols,
-                        k=self.k,
-                        rev=rev,
-                        with_should=with_should,
-                        with_embedding=with_embedding,
-                        bm=bm,
-                        bn=bn,
-                        interpret=self._interpret,
-                        emb_scale=self.config.emb_score_scale,
-                        order_exact=order_exact,
-                    )
+                    with DEVOBS.device_call(
+                        "matchmaker.score", expect_compile=True
+                    ):
+                        warm_cand = topk_candidates_big(
+                            scratch,
+                            np.full(size, -1, np.int32),
+                            grid_lo,
+                            grid_inv,
+                            fn=self.fn,
+                            fs=self.fs,
+                            n_cols=n_cols,
+                            k=self.k,
+                            rev=rev,
+                            with_should=with_should,
+                            with_embedding=with_embedding,
+                            bm=bm,
+                            bn=bn,
+                            interpret=self._interpret,
+                            emb_scale=self.config.emb_score_scale,
+                            order_exact=order_exact,
+                        )
                     if not order_exact:
                         # Pairs mode: the handshake compiles per row
                         # bucket too.
                         from .device2 import pair_partners
 
-                        pair_partners(
-                            warm_cand,
-                            jnp.asarray(np.full(size, -1, np.int32)),
-                            cap=self.pool.capacity,
-                        )
+                        with DEVOBS.device_call(
+                            "matchmaker.assign", expect_compile=True
+                        ):
+                            pair_partners(
+                                warm_cand,
+                                jnp.asarray(
+                                    np.full(size, -1, np.int32)
+                                ),
+                                cap=self.pool.capacity,
+                            )
                 except Exception as e:  # best-effort: never break dispatch
                     self._warmed_buckets.discard(
                         (size, n_cols, rev, with_should, with_embedding,

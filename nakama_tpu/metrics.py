@@ -266,6 +266,62 @@ class Metrics:
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
         )
 
+        # Device telemetry plane (devobs.py): compile-watch, kernel
+        # clocks, and the HBM ownership ledger for the shared-mesh
+        # workloads. Compile counts/label by named kernel; an
+        # xla_recompiles tick after the warmup window is the "shape
+        # churn became a p99 spike" alarm. Compile durations get their
+        # own grid (multi-second XLA compiles dwarf the RPC buckets);
+        # kernel wall times ride the latency grid.
+        self.xla_compiles = counter(
+            "xla_compiles",
+            "XLA backend compiles, by named device kernel "
+            "(unattributed = outside any registered device call)",
+            ("kernel",),
+        )
+        self.xla_recompiles = counter(
+            "xla_recompiles",
+            "Unexpected XLA recompiles after the warmup window, by "
+            "named device kernel — compile-shape churn on the hot path",
+            ("kernel",),
+        )
+        self.xla_compile_time = Histogram(
+            "xla_compile_time_sec",
+            "XLA backend compile duration",
+            (),
+            namespace=ns,
+            registry=self.registry,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                     30.0),
+        )
+        self.device_kernel_time = histo(
+            "device_kernel_time_sec",
+            "Host wall time held by each named device call "
+            "(dispatch + compile for async kernels; compute + "
+            "transfer for blocking fetches)",
+            ("kernel",),
+        )
+        self.device_memory = gauge(
+            "device_memory_bytes",
+            "Device-resident bytes by owning workload (HBM ledger; "
+            "matchmaker.pool, matchmaker.dispatch, leaderboard.boards)",
+            ("owner",),
+        )
+        self.device_memory_high_water = gauge(
+            "device_memory_high_water_bytes",
+            "High-watermark of total ledger-tracked device bytes",
+        )
+        self.device_transfers = counter(
+            "device_transfers",
+            "Host<->device transfers by call site and direction",
+            ("site", "direction"),
+        )
+        self.device_transfer_bytes = counter(
+            "device_transfer_bytes",
+            "Host<->device bytes moved, by call site and direction",
+            ("site", "direction"),
+        )
+
         # Tracing + SLO plane (tracing.py): tail-sampling decisions on
         # completed traces (kept_error / kept_slow / kept_sampled /
         # dropped) and the multi-window error-budget burn per SLO.

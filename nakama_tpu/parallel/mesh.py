@@ -31,6 +31,39 @@ def make_mesh(n_devices: int | None = None, axis: str = "pool") -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
+def describe_mesh(mesh: Mesh | None = None, pool_capacity: int = 0) -> dict:
+    """Operator view of the device mesh for the telemetry console
+    (`/v2/console/device`): every visible device with platform/kind,
+    plus — when a mesh is live — the axis layout and the per-device
+    slot shard the pool's column axis splits into. Never raises; a
+    jax-less host reports devices: []."""
+    try:
+        import jax as _jax
+
+        devices = [
+            {
+                "id": d.id,
+                "platform": d.platform,
+                "kind": getattr(d, "device_kind", ""),
+                "process": getattr(d, "process_index", 0),
+            }
+            for d in _jax.devices()
+        ]
+    except Exception:
+        devices = []
+    out: dict = {"devices": devices, "mesh": None}
+    if mesh is not None:
+        axes = dict(mesh.shape)
+        out["mesh"] = {
+            "axes": axes,
+            "devices": [d.id for d in mesh.devices.flat],
+        }
+        n = int(np.prod(list(axes.values()))) or 1
+        if pool_capacity:
+            out["mesh"]["slots_per_device"] = pool_capacity // n
+    return out
+
+
 def shard_pool(pool: dict, mesh: Mesh, axis: str = "pool") -> dict:
     """Place pool arrays sharded along their slot axis."""
     sharding = NamedSharding(mesh, P(axis))

@@ -165,6 +165,21 @@ class NakamaServer:
             export_path=tc.export_path,
             metrics=self.metrics,
         )
+        # Device telemetry plane (devobs.py): process-global like the
+        # trace store — configure from config.devobs and hand it this
+        # server's metrics registry + logger so compile-watch WARNs and
+        # the xla_*/device_* families land where operators look.
+        from .devobs import DEVOBS
+
+        dv = config.devobs
+        DEVOBS.configure(
+            enabled=dv.enabled,
+            warmup_intervals=dv.warmup_intervals,
+            timeline_depth=dv.timeline_depth,
+            capture_max_ms=dv.capture_max_ms,
+            metrics=self.metrics,
+            logger=log.with_fields(subsystem="devobs"),
+        )
         self.slo = None
         if tc.enabled:
             self.slo = SloRecorder(
@@ -518,6 +533,17 @@ class NakamaServer:
                 export_path=tc.export_path or None,
                 slo_target=tc.slo_target,
                 slo_overload_feedback=tc.slo_overload_feedback,
+            )
+        dv = self.config.devobs
+        if dv.enabled:
+            # The device-telemetry posture in one line (PR 5/6
+            # convention): an operator chasing a compile spike or an
+            # HBM number reads the knobs off the boot log.
+            self.logger.info(
+                "device telemetry enabled",
+                warmup_intervals=dv.warmup_intervals,
+                timeline_depth=dv.timeline_depth,
+                capture_max_ms=dv.capture_max_ms,
             )
         mm_cfg = self.config.matchmaker
         if mm_cfg.interval_pipelining:

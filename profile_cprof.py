@@ -19,6 +19,7 @@ N_INT = int(os.environ.get("PROF_INTERVALS", 6))
 PROF_FROM = int(os.environ.get("PROF_FROM", 3))
 
 from bench import build_ticket, fill  # noqa: E402
+from profile_interval import print_device_report  # noqa: E402
 from nakama_tpu.config import MatchmakerConfig  # noqa: E402
 from nakama_tpu.logger import test_logger  # noqa: E402
 from nakama_tpu.matchmaker import LocalMatchmaker  # noqa: E402
@@ -84,6 +85,7 @@ def main():
     st = pstats.Stats(prof, stream=s)
     st.sort_stats("tottime").print_stats(40)
     print(s.getvalue())
+    print_device_report()
 
 
 if __name__ == "__main__":

@@ -13,6 +13,7 @@ Two views per run:
 """
 
 import os
+import sys
 import threading
 import time
 
@@ -21,6 +22,21 @@ import numpy as np
 POOL = int(os.environ.get("BENCH_POOL", 100_000))
 
 from bench import build_ticket, fill  # noqa: E402
+from nakama_tpu.devobs import DEVOBS  # noqa: E402
+
+
+def print_device_report():
+    """Shared telemetry tables (devobs.py): kernel clocks +
+    compile-watch + HBM ledger + transfer counters — identical across
+    the three profiling scripts so they can't drift from the shipped
+    code paths. Printed with `--device` (or PROF_DEVICE=1)."""
+    if "--device" not in sys.argv[1:] and not os.environ.get(
+        "PROF_DEVICE"
+    ):
+        return
+    for line in DEVOBS.report_lines():
+        print(line, flush=True)
+
 from nakama_tpu.config import MatchmakerConfig  # noqa: E402
 from nakama_tpu.logger import test_logger  # noqa: E402
 from nakama_tpu.matchmaker import LocalMatchmaker  # noqa: E402
@@ -158,6 +174,7 @@ def main():
             f"p99={s['p99']*1000:.1f}ms n={s['n']}"
         )
     print(f"published entries total: {matched_entries[0]}")
+    print_device_report()
     mm.stop()
 
 

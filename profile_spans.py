@@ -15,6 +15,7 @@ POOL = int(os.environ.get("BENCH_POOL", 100_000))
 INTERVALS = int(os.environ.get("PROF_INTERVALS", 10))
 
 from bench import build_ticket, fill, ticket_cfg3, ticket_cfg5  # noqa: E402
+from profile_interval import print_device_report  # noqa: E402
 from nakama_tpu.config import MatchmakerConfig  # noqa: E402
 from nakama_tpu.logger import test_logger  # noqa: E402
 from nakama_tpu.matchmaker import LocalMatchmaker  # noqa: E402
@@ -131,6 +132,7 @@ def main():
             f" reason={rec['reason']} dur={rec['duration_ms']}ms"
             f" spans={names}"
         )
+    print_device_report()
 
 
 if __name__ == "__main__":

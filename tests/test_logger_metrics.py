@@ -121,6 +121,11 @@ GOLDEN_EXPOSITION = {
     ("nakama_db_peak_concurrent_reads", "Gauge", ()),
     ("nakama_db_write_batch_size", "Histogram", ()),
     ("nakama_db_write_queue_depth", "Gauge", ()),
+    ("nakama_device_kernel_time_sec", "Histogram", ("kernel",)),
+    ("nakama_device_memory_bytes", "Gauge", ("owner",)),
+    ("nakama_device_memory_high_water_bytes", "Gauge", ()),
+    ("nakama_device_transfer_bytes", "Counter", ("site", "direction")),
+    ("nakama_device_transfers", "Counter", ("site", "direction")),
     ("nakama_faults_injected", "Counter", ("point", "mode")),
     ("nakama_leaderboard_device_state", "Gauge", ()),
     ("nakama_leaderboard_flush_lag_sec", "Histogram", ()),
@@ -159,6 +164,9 @@ GOLDEN_EXPOSITION = {
     ("nakama_slo_burn_rate", "Gauge", ("slo", "window")),
     ("nakama_socket_outgoing_dropped", "Counter", ()),
     ("nakama_traces_sampled", "Counter", ("decision",)),
+    ("nakama_xla_compile_time_sec", "Histogram", ()),
+    ("nakama_xla_compiles", "Counter", ("kernel",)),
+    ("nakama_xla_recompiles", "Counter", ("kernel",)),
 }
 
 
