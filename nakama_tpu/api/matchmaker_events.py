@@ -84,8 +84,17 @@ def make_matched_handler(
                     body["match_id"] = match_id
                 else:
                     body["token"] = token
+                # Cluster: a forwarded ticket's presences carry their
+                # origin node — route the envelope there (the cluster
+                # router ships it over the bus; single-node presences
+                # carry no node and stay local).
                 router.send_to_presence_ids(
-                    [PresenceID(node, entry.presence.session_id)],
+                    [
+                        PresenceID(
+                            entry.presence.node or node,
+                            entry.presence.session_id,
+                        )
+                    ],
                     {"matchmaker_matched": body},
                 )
 

@@ -1,0 +1,51 @@
+"""Multi-process clustering behind the `node` seam.
+
+The reference threads a `node` name through every presence, ticket and
+match ID precisely as the seam where its closed-source clustered
+edition plugs in (SURVEY §1). This package is that edition for the
+reproduction: a length-prefixed frame bus over TCP/UDS (`bus.py`),
+heartbeat membership with explicit down-detection (`membership.py`),
+cluster-aware wrappers for the realtime layer (`presence.py` — local
+sessions stay local, presence writes replicate as bus events, stream
+sends route by the node component of the presence ID, a node death
+sweeps its presences from survivors), and fan-in matchmaker ingest
+(`matchmaker.py` — N frontend nodes forward adds/removes to the single
+device-owner node, which runs the existing device pool unchanged and
+publishes matched cohorts back to each ticket's origin node).
+
+`plane.py` assembles bus + membership from config; `server.py` swaps
+the Local* components for the Cluster* ones when `cluster.enabled`.
+No handler code changes: the wrappers implement the same surfaces.
+"""
+
+from .bus import ClusterBus, ClusterPeerDown, decode_frames, encode_frame
+from .matchmaker import (
+    ClusterMatchmakerClient,
+    ClusterMatchmakerIngest,
+    cluster_matched_handler,
+)
+from .membership import Membership
+from .plane import ClusterPlane, cluster_peers_signal
+from .presence import (
+    ClusterMessageRouter,
+    ClusterSessionRegistry,
+    ClusterStreamManager,
+    ClusterTracker,
+)
+
+__all__ = [
+    "ClusterBus",
+    "ClusterPeerDown",
+    "ClusterMatchmakerClient",
+    "ClusterMatchmakerIngest",
+    "ClusterMessageRouter",
+    "ClusterPlane",
+    "ClusterSessionRegistry",
+    "ClusterStreamManager",
+    "ClusterTracker",
+    "Membership",
+    "cluster_matched_handler",
+    "cluster_peers_signal",
+    "decode_frames",
+    "encode_frame",
+]

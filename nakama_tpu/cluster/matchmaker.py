@@ -1,0 +1,496 @@
+"""Fan-in matchmaker ingest: N frontends → one device-owner node.
+
+The device pool, interval loop, journal and checkpoints all stay on
+the single `device_owner` node, completely unchanged — what clusters
+is the *entry points*. Frontends run a `ClusterMatchmakerClient`
+behind the exact LocalMatchmaker surface the pipeline, socket close
+path and party registry already call: `add` validates synchronously
+(query syntax, counts, per-session/party MaxTickets against the
+frontend's own forwarded-ticket bookkeeping), mints the node-stamped
+ticket id ``<uuid>.<node>`` — the ID seam the reference threads for
+its clustered edition — and forwards one `mm.add` frame to the owner.
+Removals forward the same way; a dead owner degrades to a synchronous
+`ErrNotAvailable` (the client retries), never a hang.
+
+On the owner, `ClusterMatchmakerIngest` feeds forwarded ops into the
+real LocalMatchmaker (journaled like any local add, so a crash replays
+them) and `cluster_matched_handler` wraps the PR 4 delivery stage:
+matched cohorts route their envelopes back to each ticket's origin
+node through the cluster router, notify origins so frontends release
+their bookkeeping, and — when a target node is down — raise before
+delivery so the PR 7 journal records the cohort `unpublished` and a
+restart re-pools it. A frontend death sweeps its tickets from the pool
+(`remove_all(node)`), mirroring the presence sweep."""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+import numpy as np
+
+from .. import overload
+from .. import tracing as trace_api
+from ..config import MatchmakerConfig
+from ..logger import Logger
+from ..matchmaker.local import (
+    ErrDuplicateSession,
+    ErrNotAvailable,
+    ErrQueryInvalid,
+    ErrTooManyTickets,
+    MatchmakerError,
+)
+from ..matchmaker.query import QueryError, parse_query
+from ..matchmaker.types import MatchmakerPresence
+
+
+def _presences_to_wire(presences, node: str) -> list[dict]:
+    return [
+        {
+            "u": p.user_id,
+            "s": p.session_id,
+            "n": p.username,
+            "d": p.node or node,
+        }
+        for p in presences
+    ]
+
+
+def _presences_from_wire(rows, default_node: str):
+    return [
+        MatchmakerPresence(
+            user_id=r["u"],
+            session_id=r["s"],
+            username=r.get("n", ""),
+            node=r.get("d") or default_node,
+        )
+        for r in rows
+    ]
+
+
+class ClusterMatchmakerClient:
+    """Frontend-side matchmaker: the LocalMatchmaker surface, forwarded.
+
+    Holds only bookkeeping (ticket → session/party) so the synchronous
+    error contract — ErrTooManyTickets, duplicate sessions, bad
+    queries — is enforced at the socket without a bus round-trip; the
+    owner re-validates authoritatively and rejects back (`mm.reject`)
+    on disagreement (e.g. a session racing tickets through two
+    frontends)."""
+
+    backend = None  # console/server compat: no device backend here
+
+    def __init__(
+        self,
+        logger: Logger,
+        config: MatchmakerConfig,
+        bus,
+        membership,
+        node: str,
+        owner: str,
+        metrics=None,
+    ):
+        self.logger = logger.with_fields(subsystem="matchmaker.cluster")
+        self.config = config
+        self.bus = bus
+        self.membership = membership
+        self.node = node
+        self.owner = owner
+        self.metrics = metrics
+        self.on_matched = None  # owner publishes; kept for wiring compat
+        self.override_fn = None
+        self.slo = None
+        self.journal = None
+        self.checkpointer = None
+        self._session: dict[str, set[str]] = {}
+        self._party: dict[str, set[str]] = {}
+        # tid -> (presence session ids, party id, forwarded_at)
+        self._meta: dict[str, tuple[list[str], str, float]] = {}
+        # Liveness valve for the local MaxTickets pre-check: a lost
+        # `mm.matched`/`mm.reject` release frame (dropped bus frame,
+        # owner restart) must not lock a session out of matchmaking
+        # forever. Entries older than this lazily expire from the
+        # LOCAL bookkeeping only — the owner stays the authoritative
+        # enforcer (it re-checks and rejects back on overflow).
+        self.bookkeeping_ttl_sec = max(
+            300.0, 4.0 * config.interval_sec * config.max_intervals
+        )
+        bus.on("mm.matched", self._on_matched)
+        bus.on("mm.reject", self._on_reject)
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self):
+        pass  # no interval loop on frontends
+
+    def stop(self):
+        pass
+
+    def pause(self):
+        pass
+
+    def resume(self):
+        pass
+
+    def __len__(self) -> int:
+        return len(self._meta)
+
+    @property
+    def active(self):
+        return self._meta  # len()-able console stand-in
+
+    @property
+    def tickets(self):
+        return dict.fromkeys(self._meta)
+
+    def _next_cohort_deadline(self):
+        return None  # the owner owns delivery deadlines
+
+    # -------------------------------------------------------------- add
+
+    def add(
+        self,
+        presences,
+        session_id: str,
+        party_id: str,
+        query: str,
+        min_count: int,
+        max_count: int,
+        count_multiple: int = 1,
+        string_properties=None,
+        numeric_properties=None,
+        embedding=None,
+    ):
+        dl = overload.current_deadline()
+        if dl is not None and dl.expired():
+            if self.metrics is not None:
+                self.metrics.request_deadline_exceeded.labels(
+                    stage="matchmaker"
+                ).inc()
+            raise overload.DeadlineExceeded(
+                "caller deadline expired before matchmaker add"
+            )
+        if not presences:
+            raise MatchmakerError("at least one presence required")
+        if count_multiple < 1:
+            raise MatchmakerError("count_multiple must be >= 1")
+        if min_count < 1 or max_count < min_count:
+            raise MatchmakerError("invalid min/max counts")
+        if len(presences) > max_count:
+            raise MatchmakerError("more presences than max_count")
+        try:
+            parse_query(query)
+        except QueryError as e:
+            raise ErrQueryInvalid(str(e)) from e
+        seen: set[str] = set()
+        for p in presences:
+            if p.session_id in seen:
+                raise ErrDuplicateSession(p.session_id)
+            seen.add(p.session_id)
+        self._expire_stale_bookkeeping()
+        max_tickets = self.config.max_tickets
+        for p in presences:
+            if len(self._session.get(p.session_id, ())) >= max_tickets:
+                raise ErrTooManyTickets(p.session_id)
+        if party_id and len(self._party.get(party_id, ())) >= max_tickets:
+            raise ErrTooManyTickets(party_id)
+        if not self.membership.is_up(self.owner):
+            raise ErrNotAvailable("matchmaker owner node unreachable")
+
+        ticket_id = f"{uuid.uuid4()}.{self.node}"
+        created_at = time.time()
+        payload = {
+            "ticket": ticket_id,
+            "presences": _presences_to_wire(presences, self.node),
+            "sid": session_id,
+            "pid": party_id,
+            "q": query,
+            "min": min_count,
+            "max": max_count,
+            "mult": count_multiple,
+            "sp": dict(string_properties or {}),
+            "np": dict(numeric_properties or {}),
+            "at": created_at,
+            "emb": (
+                np.asarray(embedding, dtype=np.float32).tolist()
+                if embedding is not None
+                else None
+            ),
+        }
+        try:
+            sent = self.bus.send(self.owner, "mm.add", payload)
+        except Exception as e:
+            # An armed cluster.send fault or a writer race degrades to
+            # the synchronous error contract, never a half-registered
+            # ticket.
+            raise ErrNotAvailable(
+                f"matchmaker forward failed: {e}"
+            ) from e
+        if not sent:
+            raise ErrNotAvailable("matchmaker forward dropped")
+        for p in presences:
+            self._session.setdefault(p.session_id, set()).add(ticket_id)
+        if party_id:
+            self._party.setdefault(party_id, set()).add(ticket_id)
+        self._meta[ticket_id] = (
+            [p.session_id for p in presences],
+            party_id,
+            time.monotonic(),
+        )
+        if self.metrics is not None:
+            self.metrics.cluster_forwards.labels(op="add").inc()
+        sp = trace_api.current_span()
+        if sp is not None:
+            trace_api.emit_span(
+                sp.trace_id, sp.span_id, "matchmaker.add",
+                start_ts=created_at, end_ts=time.time(),
+                ticket=ticket_id, query=query, forwarded_to=self.owner,
+            )
+        return ticket_id, created_at
+
+    # ---------------------------------------------------------- removal
+
+    def _expire_stale_bookkeeping(self):
+        """Drop local bookkeeping entries whose release frame is long
+        overdue (O(live tickets), amortized by the early-out)."""
+        now = time.monotonic()
+        stale = [
+            tid
+            for tid, (_, _, at) in self._meta.items()
+            if now - at > self.bookkeeping_ttl_sec
+        ]
+        for tid in stale:
+            self.logger.warn(
+                "expiring stale forwarded-ticket bookkeeping (release"
+                " frame lost?)",
+                ticket=tid,
+            )
+            self._drop_bookkeeping(tid)
+
+    def _drop_bookkeeping(self, ticket_id: str):
+        meta = self._meta.pop(ticket_id, None)
+        if meta is None:
+            return
+        sids, party_id, _ = meta
+        for sid in sids:
+            tids = self._session.get(sid)
+            if tids is not None:
+                tids.discard(ticket_id)
+                if not tids:
+                    del self._session[sid]
+        if party_id:
+            tids = self._party.get(party_id)
+            if tids is not None:
+                tids.discard(ticket_id)
+                if not tids:
+                    del self._party[party_id]
+
+    def _forward_remove(self, body: dict):
+        try:
+            self.bus.send(self.owner, "mm.remove", body)
+        except Exception as e:
+            # Best-effort: the owner also sweeps on session death /
+            # node death; a lost remove costs one interval of a ghost
+            # ticket, never a wedge.
+            self.logger.warn("remove forward failed", error=str(e))
+        if self.metrics is not None:
+            self.metrics.cluster_forwards.labels(op="remove").inc()
+
+    def remove_session(self, session_id: str, ticket_id: str):
+        if ticket_id not in self._session.get(session_id, ()):
+            raise MatchmakerError("ticket not found")
+        self._forward_remove(
+            {"op": "ticket", "ticket": ticket_id, "sid": session_id}
+        )
+        self._drop_bookkeeping(ticket_id)
+
+    def remove_session_all(self, session_id: str):
+        tids = list(self._session.get(session_id, ()))
+        self._forward_remove({"op": "session_all", "sid": session_id})
+        for tid in tids:
+            self._drop_bookkeeping(tid)
+
+    def remove_party(self, party_id: str, ticket_id: str):
+        if ticket_id not in self._party.get(party_id, ()):
+            raise MatchmakerError("ticket not found")
+        self._forward_remove(
+            {"op": "party", "ticket": ticket_id, "pid": party_id}
+        )
+        self._drop_bookkeeping(ticket_id)
+
+    def remove_party_all(self, party_id: str):
+        tids = list(self._party.get(party_id, ()))
+        self._forward_remove({"op": "party_all", "pid": party_id})
+        for tid in tids:
+            self._drop_bookkeeping(tid)
+
+    def remove(self, ticket_ids):
+        self._forward_remove({"op": "tickets", "tickets": list(ticket_ids)})
+        for tid in ticket_ids:
+            self._drop_bookkeeping(tid)
+
+    def remove_all(self, node: str):
+        if node != self.node:
+            return
+        tids = list(self._meta)
+        self._forward_remove({"op": "node", "node": node})
+        for tid in tids:
+            self._drop_bookkeeping(tid)
+
+    # ------------------------------------------------------ owner events
+
+    def _on_matched(self, src: str, d: dict):
+        """The owner matched (and routed envelopes for) these tickets:
+        release the frontend's bookkeeping. The envelopes themselves
+        arrive via `route` frames — this is bookkeeping-only."""
+        for tid in d.get("tickets", ()):
+            self._drop_bookkeeping(tid)
+        if self.metrics is not None:
+            self.metrics.cluster_forwards.labels(op="matched").inc()
+
+    def _on_reject(self, src: str, d: dict):
+        tid = d.get("ticket", "")
+        self.logger.warn(
+            "forwarded ticket rejected by owner",
+            ticket=tid,
+            reason=d.get("reason", ""),
+        )
+        self._drop_bookkeeping(tid)
+        if self.metrics is not None:
+            self.metrics.cluster_forwards.labels(op="reject").inc()
+
+
+class ClusterMatchmakerIngest:
+    """Owner-side bus endpoints feeding the REAL LocalMatchmaker.
+
+    Forwarded adds run the exact local `add` path (validation, slot
+    registration, device on_add, PR 7 journal) under the origin's
+    pre-minted node-stamped ticket id, so every downstream system —
+    pool, journal, checkpoints, traces — sees cluster tickets as
+    ordinary tickets whose presences carry a foreign node."""
+
+    def __init__(self, matchmaker, bus, logger: Logger, metrics=None):
+        self.mm = matchmaker
+        self.bus = bus
+        self.logger = logger.with_fields(subsystem="matchmaker.ingest")
+        self.metrics = metrics
+        bus.on("mm.add", self._on_add)
+        bus.on("mm.remove", self._on_remove)
+
+    def _on_add(self, src: str, d: dict):
+        tid = d.get("ticket", "")
+        try:
+            # Shape validation OUTSIDE the add call: a malformed frame
+            # must reject back loudly, never be mistaken for the
+            # duplicate-redelivery KeyError the dup guard raises.
+            presences = _presences_from_wire(d["presences"], src)
+            args = (
+                d.get("sid", ""),
+                d.get("pid", ""),
+                d.get("q", "*"),
+                int(d["min"]),
+                int(d["max"]),
+                int(d.get("mult", 1)),
+                d.get("sp") or {},
+                {k: float(v) for k, v in (d.get("np") or {}).items()},
+            )
+            embedding = (
+                np.asarray(d["emb"], dtype=np.float32)
+                if d.get("emb") is not None
+                else None
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            self.bus.send(
+                src,
+                "mm.reject",
+                {"ticket": tid, "reason": f"malformed add frame: {e}"},
+            )
+            return
+        try:
+            self.mm.add(
+                presences, *args,
+                embedding=embedding,
+                ticket_id=tid,
+                created_at=d.get("at"),
+            )
+        except MatchmakerError as e:
+            self.bus.send(
+                src, "mm.reject", {"ticket": tid, "reason": str(e)}
+            )
+        except KeyError:
+            # Duplicate id (re-delivered frame): already registered.
+            pass
+
+    def _on_remove(self, src: str, d: dict):
+        op = d.get("op", "")
+        try:
+            if op == "ticket":
+                self.mm.remove_session(d["sid"], d["ticket"])
+            elif op == "session_all":
+                self.mm.remove_session_all(d["sid"])
+            elif op == "party":
+                self.mm.remove_party(d["pid"], d["ticket"])
+            elif op == "party_all":
+                self.mm.remove_party_all(d["pid"])
+            elif op == "tickets":
+                self.mm.remove(d.get("tickets", ()))
+            elif op == "node":
+                self.mm.remove_all(d.get("node", src))
+        except MatchmakerError:
+            pass  # already matched/removed: the race is benign
+
+
+def cluster_matched_handler(
+    inner, bus, membership, node: str, logger: Logger, metrics=None
+):
+    """Wrap the owner's `on_matched` (make_matched_handler) for the
+    cluster, per-cohort: cohorts whose every origin node is UP deliver
+    normally (envelopes routed back through the cluster router,
+    `mm.matched` releasing frontend bookkeeping); a cohort with ANY
+    down origin is HELD — raising PartialPublish after the healthy
+    deliveries makes `_publish` hand only the held tickets to the PR 7
+    journal as `unpublished`, so a restart re-pools exactly them. An
+    interval must never hold its healthy cohorts hostage to one dead
+    node, and must never re-pool a cohort whose players already saw
+    the match."""
+    log = logger.with_fields(subsystem="matchmaker.cluster")
+
+    def on_matched(batch):
+        healthy = []
+        held: set[str] = set()
+        held_nodes: set[str] = set()
+        notify: dict[str, set[str]] = {}
+        for entries in batch:
+            origin_nodes = {e.presence.node or node for e in entries}
+            down = [
+                n for n in origin_nodes
+                if n != node and not membership.is_up(n)
+            ]
+            if down:
+                held.update(e.ticket for e in entries)
+                held_nodes.update(down)
+            else:
+                healthy.append(entries)
+                for e in entries:
+                    n = e.presence.node or node
+                    if n != node:
+                        notify.setdefault(n, set()).add(e.ticket)
+        if healthy:
+            inner(healthy)
+            for n, tids in notify.items():
+                bus.send(n, "mm.matched", {"tickets": sorted(tids)})
+        if held:
+            log.warn(
+                "matched cohorts held: origin node(s) down —"
+                " journaling unpublished for re-pool",
+                nodes=sorted(held_nodes),
+                held_tickets=len(held),
+                delivered_cohorts=len(healthy),
+            )
+            from ..matchmaker.local import PartialPublish
+
+            raise PartialPublish(
+                held, reason=f"origin nodes down: {sorted(held_nodes)}"
+            )
+
+    return on_matched

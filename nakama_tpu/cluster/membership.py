@@ -1,0 +1,183 @@
+"""Peer liveness: heartbeats, down-detection, up/down callbacks.
+
+Liveness piggybacks on real traffic — the bus calls `note_frame` for
+every inbound frame — and the heartbeat task covers idle links. A peer
+silent past `down_after_ms` is explicitly DOWN: the callbacks fire
+once per transition (survivors sweep its presences, the owner sweeps
+its tickets, the overload ladder WARNs the local-only posture), and a
+frame from a down peer marks it UP again and fires the up callbacks
+(each side re-syncs its presence snapshot).
+
+The `cluster.peer_down` fault point lets chaos force a detection
+without killing a process: drop mode marks the first live peer down
+for one sweep."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable
+
+from .. import faults
+from ..logger import Logger
+
+UNKNOWN = "unknown"  # configured, never seen — not swept, not routed
+UP = "up"
+DOWN = "down"
+
+
+class Membership:
+    def __init__(
+        self,
+        bus,
+        logger: Logger,
+        metrics=None,
+        *,
+        heartbeat_ms: int = 500,
+        down_after_ms: int = 2500,
+    ):
+        self.bus = bus
+        self.logger = logger.with_fields(subsystem="cluster.membership")
+        self.metrics = metrics
+        self.heartbeat_s = max(0.01, heartbeat_ms / 1000.0)
+        self.down_after_s = max(self.heartbeat_s * 2, down_after_ms / 1000.0)
+        self.state: dict[str, str] = {p: UNKNOWN for p in bus.peers}
+        self.last_seen: dict[str, float] = {}
+        self.peer_info: dict[str, dict] = {}  # last heartbeat body
+        self.on_peer_down: list[Callable[[str], None]] = []
+        self.on_peer_up: list[Callable[[str], None]] = []
+        self._task: asyncio.Task | None = None
+        self._hb_seq = 0
+        bus.frame_hook = self.note_frame
+        bus.peer_added_hook = self.add_peer
+        bus.on("hb", self._on_hb)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self):
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def add_peer(self, name: str):
+        """Track a peer registered after construction (bus.add_peer)."""
+        self.state.setdefault(name, UNKNOWN)
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    # ------------------------------------------------------------ queries
+
+    def is_up(self, peer: str) -> bool:
+        return self.state.get(peer) == UP
+
+    def up_peers(self) -> list[str]:
+        return sorted(p for p, s in self.state.items() if s == UP)
+
+    def down_peers(self) -> list[str]:
+        return sorted(p for p, s in self.state.items() if s == DOWN)
+
+    def any_down(self) -> bool:
+        return any(s == DOWN for s in self.state.values())
+
+    # ------------------------------------------------------------- events
+
+    def note_frame(self, src: str):
+        """Every inbound frame proves liveness (bus.frame_hook)."""
+        if src not in self.state:
+            return
+        self.last_seen[src] = time.monotonic()
+        if self.state[src] != UP:
+            self._transition(src, UP)
+
+    def _on_hb(self, src: str, body: dict):
+        self.peer_info[src] = body
+
+    def _transition(self, peer: str, new: str):
+        old = self.state.get(peer)
+        self.state[peer] = new
+        if new == DOWN:
+            self.logger.warn(
+                "cluster peer DOWN — local-only posture for its"
+                " sessions until it returns",
+                peer=peer,
+                down_after_s=round(self.down_after_s, 2),
+            )
+            for cb in self.on_peer_down:
+                try:
+                    cb(peer)
+                except Exception as e:
+                    self.logger.error(
+                        "peer-down callback error", peer=peer, error=str(e)
+                    )
+        elif new == UP:
+            self.logger.info("cluster peer up", peer=peer, was=old)
+            for cb in self.on_peer_up:
+                try:
+                    cb(peer)
+                except Exception as e:
+                    self.logger.error(
+                        "peer-up callback error", peer=peer, error=str(e)
+                    )
+        self._publish_gauges()
+
+    def _publish_gauges(self):
+        if self.metrics is None:
+            return
+        states = list(self.state.values())
+        self.metrics.cluster_peers.labels(state="up").set(
+            states.count(UP)
+        )
+        self.metrics.cluster_peers.labels(state="down").set(
+            states.count(DOWN)
+        )
+
+    # --------------------------------------------------------------- loop
+
+    async def _loop(self):
+        self._publish_gauges()
+        while True:
+            try:
+                self._hb_seq += 1
+                self.bus.broadcast(
+                    "hb",
+                    {
+                        "seq": self._hb_seq,
+                        "t": time.time(),
+                    },
+                )
+                self.sweep()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # The liveness loop must survive anything a callback or
+                # a metrics sink throws.
+                self.logger.error("membership sweep error", error=str(e))
+            await asyncio.sleep(self.heartbeat_s)
+
+    def sweep(self, now: float | None = None):
+        """One down-detection pass (called on the heartbeat cadence;
+        tests call it directly with a fake now)."""
+        forced = False
+        try:
+            forced = faults.fire("cluster.peer_down")
+        except Exception as e:
+            self.logger.warn("peer_down fault", error=str(e))
+        now = time.monotonic() if now is None else now
+        for peer, state in list(self.state.items()):
+            if state != UP:
+                continue
+            if forced:
+                # Drop-mode chaos: force ONE live peer down this sweep.
+                forced = False
+                self._transition(peer, DOWN)
+                continue
+            seen = self.last_seen.get(peer)
+            if seen is not None and now - seen > self.down_after_s:
+                self._transition(peer, DOWN)
+
+    def stats(self) -> dict:
+        return {
+            "state": dict(self.state),
+            "peer_info": dict(self.peer_info),
+        }

@@ -1,0 +1,115 @@
+"""Cluster plane assembly: bus + membership from config, plus the
+cross-cutting hooks (peer-up presence resync, peer-down sweeps, the
+overload ladder's local-only WARN signal)."""
+
+from __future__ import annotations
+
+from .. import overload
+from ..config import Config
+from ..logger import Logger
+from .bus import ClusterBus
+from .membership import Membership
+
+
+def parse_peers(specs) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for spec in specs:
+        name, _, addr = spec.partition("=")
+        out[name] = addr
+    return out
+
+
+class ClusterPlane:
+    """Owns the bus and membership for one node. Components register
+    their bus handlers at construction; `wire_sweeps` binds the
+    death/recovery hooks once the tracker (and, on the owner, the
+    matchmaker) exist."""
+
+    def __init__(self, config: Config, logger: Logger, metrics=None):
+        cc = config.cluster
+        self.config = config
+        self.node = config.name
+        self.role = cc.role
+        self.owner = cc.device_owner or (
+            config.name if cc.role == "device_owner" else ""
+        )
+        self.logger = logger.with_fields(subsystem="cluster")
+        self.bus = ClusterBus(
+            config.name,
+            cc.bind,
+            parse_peers(cc.peers),
+            logger,
+            metrics,
+            send_queue_depth=cc.send_queue_depth,
+            max_frame_bytes=cc.max_frame_bytes,
+            breaker_threshold=cc.breaker_threshold,
+            breaker_cooldown_ms=cc.breaker_cooldown_ms,
+            codec=cc.codec,
+        )
+        self.membership = Membership(
+            self.bus,
+            logger,
+            metrics,
+            heartbeat_ms=cc.heartbeat_ms,
+            down_after_ms=cc.down_after_ms,
+        )
+
+    @property
+    def is_owner(self) -> bool:
+        return self.role == "device_owner"
+
+    def wire_sweeps(self, tracker, matchmaker=None):
+        """Peer death: sweep its presences from this node's view (leave
+        events fire locally → match/party registries + clients); on the
+        owner additionally sweep its tickets from the pool (journaled
+        removes — the PR 7 audit sees them). Peer recovery: push this
+        node's local-presence snapshot so the returning node rebuilds
+        its remote view."""
+
+        def on_down(peer: str):
+            tracker.sweep_node(peer)
+            if matchmaker is not None:
+                matchmaker.remove_all(peer)
+
+        def on_up(peer: str):
+            self.bus.send(
+                peer, "pr.sync", {"presences": tracker.local_presences()}
+            )
+
+        self.membership.on_peer_down.append(on_down)
+        self.membership.on_peer_up.append(on_up)
+
+    async def start(self):
+        await self.bus.start()
+        self.membership.start()
+        self.logger.info(
+            "cluster enabled",
+            role=self.role,
+            owner=self.owner,
+            peers=sorted(self.bus.peers),
+            heartbeat_ms=self.config.cluster.heartbeat_ms,
+            down_after_ms=self.config.cluster.down_after_ms,
+        )
+
+    async def stop(self):
+        self.membership.stop()
+        await self.bus.stop()
+
+    def stats(self) -> dict:
+        return {
+            "role": self.role,
+            "owner": self.owner,
+            "bus": self.bus.stats(),
+            "membership": self.membership.stats(),
+        }
+
+
+def cluster_peers_signal(membership):
+    """Overload-ladder signal: any DOWN peer is the local-only degraded
+    posture — WARN (tighten admission, stop queueing LIST) but never
+    SHED on membership alone; local traffic still serves."""
+
+    def signal() -> int:
+        return overload.WARN if membership.any_down() else overload.OK
+
+    return signal

@@ -437,6 +437,50 @@ class SocialConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """Multi-process clustering (cluster/): the cross-node bus, sharded
+    presence, and fan-in matchmaker ingest behind the `node` seam the
+    reference threads through every presence/ticket/match ID (SURVEY
+    §1). Disabled by default — the single-process build is unchanged.
+
+    Topology is static config, not discovery: every node lists every
+    peer as ``name=host:port`` (or ``name=unix:/path`` for UDS), and
+    exactly ONE node runs with ``role: device_owner`` — it owns the
+    device pool and the interval loop; ``frontend`` nodes terminate
+    sockets and forward `MatchmakerAdd`/`Remove` over the bus."""
+
+    enabled: bool = False
+    # device_owner: runs the real matchmaker (device pool, interval
+    # loop, journal/checkpoints). frontend: terminates sessions and
+    # forwards matchmaker ops to the device-owner node.
+    role: str = "device_owner"
+    # This node's bus listener, `host:port` or `unix:/path`.
+    bind: str = "127.0.0.1:7353"
+    # Every OTHER node, as `name=host:port` / `name=unix:/path`.
+    peers: list[str] = field(default_factory=list)
+    # Node name of the device owner; required for frontends (the
+    # fan-in target). Defaults to this node's own name on the owner.
+    device_owner: str = ""
+    # Peer liveness: heartbeats every heartbeat_ms; a peer silent for
+    # down_after_ms is DOWN — its presences are swept from survivors
+    # (leave events fired) and, on the owner, its tickets leave the
+    # pool.
+    heartbeat_ms: int = 500
+    down_after_ms: int = 2500
+    # Per-peer bounded outbound queue; overflow drops oldest (the
+    # degradation posture: a dead peer costs frames, never memory or a
+    # wedged sender).
+    send_queue_depth: int = 4096
+    max_frame_bytes: int = 4_194_304
+    # Per-peer connect/write breaker (faults.CircuitBreaker): open =
+    # reconnect attempts decay instead of hammering a dead address.
+    breaker_threshold: int = 3
+    breaker_cooldown_ms: int = 1000
+    # Frame codec: json (always available) | msgpack (when installed).
+    codec: str = "json"
+
+
+@dataclass
 class Config:
     name: str = "nakama-tpu"
     data_dir: str = "./data"
@@ -465,6 +509,7 @@ class Config:
     tracing: TracingConfig = field(default_factory=TracingConfig)
     recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
     devobs: DevObsConfig = field(default_factory=DevObsConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
 
     @property
     def node(self) -> str:
@@ -472,7 +517,68 @@ class Config:
 
     def check(self) -> list[str]:
         """Sanity-check the config; returns warnings (shown in console)."""
+        import re
+
         warnings: list[str] = []
+        # The node name is embedded in presence/ticket/match IDs with
+        # "." as the separator (e.g. `<uuid>.<node>` rendezvous and
+        # cluster ticket ids) and is parsed back out by rsplit — a name
+        # containing the separator or other unvetted chars silently
+        # corrupts ID parsing at the exact seam clustering routes on.
+        if not re.fullmatch(r"[A-Za-z0-9_-]+", self.name or ""):
+            raise ValueError(
+                "name must be non-empty and contain only"
+                " [A-Za-z0-9_-] (it is embedded in presence/ticket/"
+                "match IDs with '.' as the separator)"
+            )
+        cl = self.cluster
+        if cl.enabled:
+            if cl.role not in ("device_owner", "frontend"):
+                raise ValueError(
+                    "cluster.role must be device_owner or frontend"
+                )
+            peer_names = []
+            for spec in cl.peers:
+                name, sep, addr = spec.partition("=")
+                if not sep or not name or not addr:
+                    raise ValueError(
+                        f"cluster.peers entry {spec!r} must be"
+                        " name=host:port or name=unix:/path"
+                    )
+                if not re.fullmatch(r"[A-Za-z0-9_-]+", name):
+                    raise ValueError(
+                        f"cluster.peers name {name!r} must match"
+                        " [A-Za-z0-9_-]+"
+                    )
+                peer_names.append(name)
+            if len(set(peer_names)) != len(peer_names):
+                raise ValueError("cluster.peers names must be unique")
+            if self.name in peer_names:
+                raise ValueError(
+                    "cluster.peers must not include this node itself"
+                )
+            owner = cl.device_owner or (
+                self.name if cl.role == "device_owner" else ""
+            )
+            if cl.role == "frontend" and owner not in peer_names:
+                raise ValueError(
+                    "cluster.device_owner must name a peer when"
+                    " cluster.role is frontend"
+                )
+            if cl.role == "device_owner" and cl.device_owner not in (
+                "", self.name
+            ):
+                raise ValueError(
+                    "cluster.device_owner names another node but"
+                    " cluster.role is device_owner"
+                )
+            if cl.heartbeat_ms < 10 or cl.down_after_ms <= cl.heartbeat_ms:
+                raise ValueError(
+                    "cluster.down_after_ms must exceed"
+                    " cluster.heartbeat_ms (>= 10ms)"
+                )
+            if cl.codec not in ("json", "msgpack"):
+                raise ValueError("cluster.codec must be json or msgpack")
         if self.session.encryption_key == "defaultencryptionkey":
             warnings.append("session.encryption_key is the insecure default")
         if self.socket.server_key == "defaultkey":
@@ -667,7 +773,15 @@ def parse_args(argv: list[str]) -> Config:
             i += 1
     cfg = load_config(yaml_paths, rest)
     if not cfg.name:
-        cfg.name = socket.gethostname()
+        # Hostnames may carry dots/invalid chars; the node name is an
+        # ID component (check() enforces [A-Za-z0-9_-]) — sanitize the
+        # fallback instead of failing the default boot.
+        import re
+
+        cfg.name = (
+            re.sub(r"[^A-Za-z0-9_-]", "-", socket.gethostname())
+            or "nakama"
+        )
     return cfg
 
 
@@ -706,6 +820,7 @@ __all__ = [
     "TracingConfig",
     "RecoveryConfig",
     "DevObsConfig",
+    "ClusterConfig",
     "load_config",
     "parse_args",
     "config_to_dict",

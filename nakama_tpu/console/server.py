@@ -90,6 +90,7 @@ class ConsoleServer:
         )
         r.add_get("/v2/console/match", self._h_match_list)
         r.add_get("/v2/console/matchmaker", self._h_matchmaker)
+        r.add_get("/v2/console/cluster", self._h_cluster)
         r.add_get("/v2/console/device", self._h_device)
         r.add_post("/v2/console/device/capture", self._h_device_capture)
         self._capture_busy = False
@@ -781,6 +782,35 @@ class ConsoleServer:
                     and hasattr(tracing, "ledger_totals")
                     else {}
                 ),
+            }
+        )
+
+    async def _h_cluster(self, request: web.Request):
+        """Cluster posture: role, peer liveness, per-peer bus queue /
+        breaker state, and (owner) pooled foreign tickets — "is the
+        mesh of processes healthy" off one endpoint."""
+        self._auth(request)
+        cluster = getattr(self.server, "cluster", None)
+        if cluster is None:
+            return web.json_response({"enabled": False})
+        mm = self.server.matchmaker
+        tracker = self.server.tracker
+        return web.json_response(
+            {
+                "enabled": True,
+                "node": cluster.node,
+                **cluster.stats(),
+                "presences_local": (
+                    tracker.count() - tracker.remote_count()
+                    if hasattr(tracker, "remote_count")
+                    else tracker.count()
+                ),
+                "presences_remote": (
+                    tracker.remote_count()
+                    if hasattr(tracker, "remote_count")
+                    else 0
+                ),
+                "matchmaker_tickets": len(mm),
             }
         )
 

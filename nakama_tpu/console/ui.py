@@ -92,6 +92,7 @@ const R = {
   matchList:        ['GET',    '/v2/console/match'],
   matchState:       ['GET',    '/v2/console/match/{id}/state'],
   matchmaker:       ['GET',    '/v2/console/matchmaker'],
+  cluster:          ['GET',    '/v2/console/cluster'],
   device:           ['GET',    '/v2/console/device'],
   deviceCapture:    ['POST',   '/v2/console/device/capture'],
   lbList:           ['GET',    '/v2/console/leaderboard'],
@@ -545,6 +546,12 @@ const TABS = {
   },
   matchmaker: async (el) => {
     const d = await call('matchmaker');
+    el.appendChild($(jpre(d)));
+  },
+  cluster: async (el) => {
+    // Cluster posture: role, peer liveness, per-peer bus queue +
+    // breaker state, local/remote presence split.
+    const d = await call('cluster');
     el.appendChild($(jpre(d)));
   },
   device: async (el) => {

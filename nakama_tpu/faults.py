@@ -57,6 +57,9 @@ FAULT_POINTS = (
     "checkpoint.write",  # pool snapshot write (recovery.py), per attempt
     "leaderboard.flush", # device board scatter+sort (leaderboard/device.py)
     "leaderboard.rank",  # device rank/window/sweep read, per batch
+    "cluster.send",      # bus outbound enqueue (cluster/bus.py), per frame
+    "cluster.recv",      # bus inbound dispatch (cluster/bus.py), per frame
+    "cluster.peer_down", # membership sweep; drop forces a down detection
 )
 
 

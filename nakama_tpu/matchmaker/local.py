@@ -67,6 +67,23 @@ class ErrNotAvailable(MatchmakerError):
     pass
 
 
+class PartialPublish(Exception):
+    """Raised by an `on_matched` handler that delivered SOME cohorts
+    but had to hold others (cluster: a cohort's origin node is down).
+    `failed_tickets` names every ticket of every HELD cohort — those
+    journal `unpublished` (a restart re-pools them) while the delivered
+    cohorts journal `matched` as usual. Holding must be all-or-nothing
+    per cohort: a partially-listed cohort would re-pool some of its
+    tickets after a restart while its other members already saw the
+    match."""
+
+    def __init__(self, failed_tickets, reason: str = ""):
+        super().__init__(
+            reason or f"{len(failed_tickets)} cohort ticket(s) held"
+        )
+        self.failed_tickets = frozenset(failed_tickets)
+
+
 MatchedCallback = Callable[[MatchBatch], None]
 OverrideFn = Callable[
     [list[list[MatchmakerEntry]]], list[list[MatchmakerEntry]]
@@ -592,8 +609,16 @@ class LocalMatchmaker:
         string_properties: dict[str, str] | None = None,
         numeric_properties: dict[str, float] | None = None,
         embedding=None,
+        ticket_id: str | None = None,
+        created_at: float | None = None,
     ) -> tuple[str, float]:
         """Submit a ticket. Returns (ticket id, created_at seconds).
+
+        `ticket_id`/`created_at` are normally minted here; the cluster
+        ingest (cluster/matchmaker.py) passes the origin frontend's
+        pre-minted node-stamped id and wall clock so cross-node tickets
+        keep their identity and age through the pool, journal and
+        checkpoints.
 
         Reference Add: server/matchmaker.go:443-566."""
         if self._stopped:
@@ -640,8 +665,13 @@ class LocalMatchmaker:
         ):
             raise ErrTooManyTickets(party_id)
 
-        ticket_id = str(uuid.uuid4())
-        created_at = time.time()
+        if ticket_id is None:
+            ticket_id = str(uuid.uuid4())
+        elif self.store.get(ticket_id) is not None:
+            # Re-delivered cluster forward: the id is already live.
+            raise KeyError(ticket_id)
+        if created_at is None:
+            created_at = time.time()
         string_properties = string_properties or {}
         numeric_properties = numeric_properties or {}
         entries = [
@@ -866,8 +896,11 @@ class LocalMatchmaker:
         single-shot semantics), so a failed or dropped publish is
         counted and logged loudly — the session-facing retry belongs to
         the consumer — but it must never poison interval bookkeeping.
-        Returns publish success: a False journals the cohort as an
-        `unpublished` match so a restart re-pools its tickets."""
+        Returns publish success: a False journals the whole batch as
+        `unpublished` matches so a restart re-pools the tickets; a
+        handler raising PartialPublish (cluster: some cohorts' origin
+        nodes down) returns the held tickets' id set so ONLY those
+        cohorts journal unpublished."""
         try:
             if faults.fire("delivery.publish"):
                 # drop-mode chaos: delivery intentionally discarded.
@@ -880,6 +913,16 @@ class LocalMatchmaker:
                 return False
             self.on_matched(batch)
             return True
+        except PartialPublish as e:
+            self.logger.warn(
+                "match delivery partially held",
+                held_tickets=len(e.failed_tickets),
+                matches=len(batch),
+                reason=str(e),
+            )
+            if self.metrics is not None:
+                self.metrics.mm_delivery_failed.inc()
+            return e.failed_tickets
         except Exception as e:
             self.logger.error(
                 "match delivery failed",
@@ -909,7 +952,25 @@ class LocalMatchmaker:
         else:
             arr = objs
             resolver = lambda: (arr if arr is not None else ())  # noqa: E731
-        if published_ok:
+        if isinstance(published_ok, frozenset):
+            # Partial publish (cluster: held cohorts): only the held
+            # tickets journal unpublished — journaling the delivered
+            # ones too would double-deliver their matches after a
+            # restart's re-pool.
+            held = published_ok
+            self.journal.record_unpublished(
+                lambda: [
+                    t for t in resolver()
+                    if t is not None and t.ticket in held
+                ]
+            )
+            self.journal.record_matched(
+                lambda: [
+                    t for t in resolver()
+                    if t is not None and t.ticket not in held
+                ]
+            )
+        elif published_ok:
             self.journal.record_matched(resolver)
         else:
             self.journal.record_unpublished(resolver)
@@ -1144,10 +1205,22 @@ class LocalMatchmaker:
         self._update_gauges()
 
     def remove_all(self, node: str):
-        # Single-node build: every ticket belongs to this node.
-        if node != self.node:
-            return
-        self._remove_slots(self.store.live_slots())
+        if node == self.node:
+            self._remove_slots(self.store.live_slots())
+        else:
+            # Cluster sweep: tickets whose presences belong to a (dead)
+            # foreign node. O(pool) object walk — peer death is rare
+            # and off the interval path.
+            ticket_at = self.store.ticket_at
+            slots = [
+                s
+                for s in self.store.live_slots()
+                if any(
+                    e.presence.node == node
+                    for e in ticket_at[s].entries
+                )
+            ]
+            self._remove_slots(np.asarray(slots, dtype=np.int32))
         self._update_gauges()
 
     def remove(self, ticket_ids: list[str]):

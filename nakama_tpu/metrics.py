@@ -337,6 +337,43 @@ class Metrics:
             ("slo", "window"),
         )
 
+        # Cluster plane (cluster/): peer liveness, the bus's frame flow
+        # and queue posture, matchmaker fan-in forwards, and the
+        # node-death presence sweeps. A nonzero `down` peer count is
+        # the local-only degraded posture the overload ladder WARNs on.
+        self.cluster_peers = gauge(
+            "cluster_peers",
+            "Configured cluster peers by liveness state (up, down)",
+            ("state",),
+        )
+        self.cluster_bus_queue_depth = gauge(
+            "cluster_bus_queue_depth",
+            "Outbound bus frames queued per peer",
+            ("peer",),
+        )
+        self.cluster_frames = counter(
+            "cluster_frames",
+            "Bus frames by type and direction (sent, received)",
+            ("type", "direction"),
+        )
+        self.cluster_bus_dropped = counter(
+            "cluster_bus_dropped",
+            "Bus frames dropped, by reason (peer_down, queue_full, "
+            "breaker_open, oversize, bad_frame, fault)",
+            ("reason",),
+        )
+        self.cluster_forwards = counter(
+            "cluster_forwards",
+            "Matchmaker ops forwarded to the device-owner node, by op "
+            "(add, remove, matched, reject)",
+            ("op",),
+        )
+        self.cluster_presence_sweeps = counter(
+            "cluster_presence_sweeps",
+            "Presences swept from this node's view after a peer death "
+            "(leave events fired locally)",
+        )
+
         # Message routing / presence events.
         self.outgoing_dropped = counter(
             "socket_outgoing_dropped", "Messages dropped on full session queues"

@@ -419,8 +419,22 @@ class Interp:
             this = obj
         else:
             fn = self.eval(callee, env)
-        args = [self.eval(a, env) for a in arg_nodes]
+        args = []
+        for a in arg_nodes:
+            if a[0] == "spread":
+                args.extend(self._spread_values(self.eval(a[1], env)))
+            else:
+                args.append(self.eval(a, env))
         return self.call_function(fn, args, this)
+
+    def _spread_values(self, value):
+        """Flatten one `...expr` call argument (arrays and strings —
+        the iterables this subset has)."""
+        if isinstance(value, JSArray):
+            return list(value.items)
+        if isinstance(value, str):
+            return list(value)
+        raise JsRuntimeError("spread argument is not iterable")
 
     def call_function(self, fn, args, this=UNDEFINED):
         if isinstance(fn, JSFunction):

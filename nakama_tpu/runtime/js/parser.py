@@ -357,8 +357,14 @@ class Parser:
                 args = []
                 while not self.at_op(")"):
                     if self.at_op("..."):
-                        self.err("spread is not supported in this subset")
-                    args.append(self.assignment())
+                        # Spread in call position (TS compilers emit
+                        # `fn.apply(void 0, args)` variants AND plain
+                        # `fn(...args)` depending on target): the arg
+                        # node flattens at call evaluation.
+                        self.next()
+                        args.append(("spread", self.assignment()))
+                    else:
+                        args.append(self.assignment())
                     if self.at_op(","):
                         self.next()
                 self.next()

@@ -78,26 +78,62 @@ class NakamaServer:
         from . import faults
 
         faults.PLANE.bind_metrics(self.metrics)
-        self.session_registry = LocalSessionRegistry(log, self.metrics)
+        # Cluster plane (cluster/): when enabled, the realtime layer
+        # swaps to the Cluster* wrappers (presence replication + routed
+        # fan-out over the bus) and frontend nodes run the matchmaker
+        # proxy instead of the pool. Handler code is untouched — the
+        # wrappers implement the same surfaces.
+        self.cluster = None
+        if config.cluster.enabled:
+            from .cluster import ClusterPlane
+
+            self.cluster = ClusterPlane(config, log, self.metrics)
+        bus = self.cluster.bus if self.cluster is not None else None
+        if bus is not None:
+            from .cluster import (
+                ClusterMessageRouter,
+                ClusterSessionRegistry,
+                ClusterStreamManager,
+                ClusterTracker,
+            )
+
+            self.session_registry = ClusterSessionRegistry(
+                log, self.metrics, bus=bus
+            )
+            self.tracker = ClusterTracker(
+                log, node, self.metrics,
+                config.tracker.event_queue_size, bus=bus,
+            )
+            self.router = ClusterMessageRouter(
+                log, self.session_registry, self.tracker, self.metrics,
+                bus=bus, node=node,
+            )
+        else:
+            self.session_registry = LocalSessionRegistry(log, self.metrics)
+            self.tracker = LocalTracker(
+                log, node, self.metrics, config.tracker.event_queue_size
+            )
+            self.router = LocalMessageRouter(
+                log, self.session_registry, self.tracker, self.metrics
+            )
         self.session_cache = LocalSessionCache(
             config.session.token_expiry_sec,
             config.session.refresh_token_expiry_sec,
         )
         self.login_attempt_cache = LocalLoginAttemptCache()
-        self.tracker = LocalTracker(
-            log, node, self.metrics, config.tracker.event_queue_size
-        )
-        self.router = LocalMessageRouter(
-            log, self.session_registry, self.tracker, self.metrics
-        )
         self.tracker.set_event_router(self.router.route_presence_event)
         self.status_registry = LocalStatusRegistry(log, self.session_registry)
         self.tracker.add_listener(
             StreamMode.STATUS, self.status_registry.status_listener()
         )
-        self.stream_manager = LocalStreamManager(
-            log, self.session_registry, self.tracker
-        )
+        if bus is not None:
+            self.stream_manager = ClusterStreamManager(
+                log, self.session_registry, self.tracker, bus=bus
+            )
+        else:
+            self.stream_manager = LocalStreamManager(
+                log, self.session_registry, self.tracker
+            )
         self.match_registry = LocalMatchRegistry(
             log, config.match, self.router, node, self.metrics,
             tracker=self.tracker,
@@ -105,13 +141,40 @@ class NakamaServer:
         self.tracker.add_listener(
             StreamMode.MATCH_AUTHORITATIVE, self.match_registry.join_listener()
         )
-        self.matchmaker = LocalMatchmaker(
-            log,
-            config.matchmaker,
-            self.metrics,
-            node,
-            backend=matchmaker_backend,
-        )
+        if self.cluster is not None and not self.cluster.is_owner:
+            # Frontend role: no pool, no device, no interval loop —
+            # adds/removes forward to the device-owner node over the
+            # bus behind the same LocalMatchmaker surface.
+            from .cluster import ClusterMatchmakerClient
+
+            self.matchmaker = ClusterMatchmakerClient(
+                log,
+                config.matchmaker,
+                bus,
+                self.cluster.membership,
+                node,
+                self.cluster.owner,
+                metrics=self.metrics,
+            )
+        else:
+            self.matchmaker = LocalMatchmaker(
+                log,
+                config.matchmaker,
+                self.metrics,
+                node,
+                backend=matchmaker_backend,
+            )
+        if self.cluster is not None:
+            if self.cluster.is_owner:
+                from .cluster import ClusterMatchmakerIngest
+
+                self._cluster_ingest = ClusterMatchmakerIngest(
+                    self.matchmaker, bus, log, self.metrics
+                )
+            self.cluster.wire_sweeps(
+                self.tracker,
+                self.matchmaker if self.cluster.is_owner else None,
+            )
         # Group-commit batch size / queue depth / commit counter + the
         # reader-pool high-water mark become scrapeable, and drain spans
         # (record_db_drain) land in the same Tracing ledger operators
@@ -128,7 +191,9 @@ class NakamaServer:
         # start() runs the warm restart once the engine is connected,
         # stop() drains to durable (journal flush + final checkpoint).
         self.recovery = None
-        if config.recovery.enabled:
+        if config.recovery.enabled and (
+            self.cluster is None or self.cluster.is_owner
+        ):
             from .recovery import RecoveryPlane
 
             self.recovery = RecoveryPlane(
@@ -233,12 +298,14 @@ class NakamaServer:
                 tracing=self._overload_tracing,
             )
         self.runtime = None
-        self.matchmaker.on_matched = make_matched_handler(
-            log,
-            self.router,
-            node,
-            config.session.encryption_key,
-            runtime=None,
+        self.matchmaker.on_matched = self._wrap_matched(
+            make_matched_handler(
+                log,
+                self.router,
+                node,
+                config.session.encryption_key,
+                runtime=None,
+            )
         )
         self.party_registry = LocalPartyRegistry(
             log, self.tracker, self.router, self.matchmaker, node
@@ -315,7 +382,9 @@ class NakamaServer:
         # (the workload driver builds through it too).
         lb_rank_cache = rank_cache_from_config(config.leaderboard)
         lb_device = None
-        if config.leaderboard.device_enabled:
+        if config.leaderboard.device_enabled and (
+            self.cluster is None or self.cluster.is_owner
+        ):
             # Second TPU workload on the shared mesh: large boards
             # mirror onto the device for batched rank reads; the host
             # cache stays the oracle behind the engine's breaker.
@@ -354,6 +423,23 @@ class NakamaServer:
         self.grpc = None
         self.grpc_port: int | None = None
 
+    def _wrap_matched(self, handler):
+        """On the cluster's device-owner node, matched delivery routes
+        back to each ticket's origin node and refuses (→ PR 7
+        `unpublished` journal) while a target node is down."""
+        if self.cluster is None or not self.cluster.is_owner:
+            return handler
+        from .cluster import cluster_matched_handler
+
+        return cluster_matched_handler(
+            handler,
+            self.cluster.bus,
+            self.cluster.membership,
+            self.config.name,
+            self.logger,
+            self.metrics,
+        )
+
     def attach_runtime(self, runtime):
         """Wire the extensibility runtime into the pipeline, the matchmaker
         matched hook, the match registry (named match factories), and the
@@ -361,12 +447,14 @@ class NakamaServer:
         main.go:155-160; session_ws.go Close path)."""
         self.runtime = runtime
         self.pipeline.c.runtime = runtime
-        self.matchmaker.on_matched = make_matched_handler(
-            self.logger,
-            self.router,
-            self.config.name,
-            self.config.session.encryption_key,
-            runtime=runtime,
+        self.matchmaker.on_matched = self._wrap_matched(
+            make_matched_handler(
+                self.logger,
+                self.router,
+                self.config.name,
+                self.config.session.encryption_key,
+                runtime=runtime,
+            )
         )
         override = getattr(runtime, "matchmaker_override", None)
         if override is not None and override() is not None:
@@ -389,6 +477,11 @@ class NakamaServer:
         # Match tasks always land on this loop, even when create_match is
         # driven from a guest-module worker thread.
         self.match_registry.loop = asyncio.get_running_loop()
+        if self.cluster is not None:
+            # Bus + membership FIRST: presence replication and the
+            # matchmaker fan-in must be live before sessions land and
+            # before the interval loop ticks.
+            await self.cluster.start()
         if not self._db_connected:
             await self.db.connect()
             self._db_connected = True
@@ -486,6 +579,15 @@ class NakamaServer:
                     oc.interval_lag_shed_sec,
                 ),
             )
+            if self.cluster is not None:
+                # A DOWN peer is the local-only degraded posture: WARN
+                # the ladder (tighten admission) while survivors serve.
+                from .cluster import cluster_peers_signal
+
+                self.overload.register_signal(
+                    "cluster_peers",
+                    cluster_peers_signal(self.cluster.membership),
+                )
             if self.slo is not None:
                 # The SLO plane rides the ladder's sampling cadence:
                 # each sample publishes slo_burn_rate{slo,window}; with
@@ -652,6 +754,11 @@ class NakamaServer:
                 # Non-WS session implementations keep the plain close.
                 await session.close("server shutting down")
         self.tracker.stop()
+        if self.cluster is not None:
+            # After sessions closed (their untrack_all replications ride
+            # the bus) and before the durable tail: peers detect this
+            # node's silence and sweep within down_after_ms.
+            await self.cluster.stop()
         if self.runtime is not None:
             await self.runtime.shutdown()
         if self.recovery is not None:

@@ -1,0 +1,553 @@
+"""Cluster plane units: frame codec, bus delivery + tracing, membership
+transitions, presence replication/sweep, routed fan-out, matchmaker
+fan-in (client → ingest → matched publish-back), the unpublished-on-
+peer-down journal hook, and the `cluster_regression` bench gate.
+
+All in-process: two or three ClusterBus instances on loopback TCP wired
+with `add_peer` (port-0 topologies). The subprocess SIGKILL story lives
+in test_cluster_smoke.py; chaos legs for the cluster fault points live
+in test_faults_chaos.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from fixtures import FakeSession, quiet_logger
+
+from nakama_tpu import faults
+from nakama_tpu import tracing as trace_api
+from nakama_tpu.api.matchmaker_events import make_matched_handler
+from nakama_tpu.cluster import (
+    ClusterBus,
+    ClusterMatchmakerClient,
+    ClusterMatchmakerIngest,
+    ClusterMessageRouter,
+    ClusterSessionRegistry,
+    ClusterTracker,
+    Membership,
+    cluster_matched_handler,
+    cluster_peers_signal,
+    decode_frames,
+    encode_frame,
+)
+from nakama_tpu.cluster.bus import _codec
+from nakama_tpu.config import MatchmakerConfig
+from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+from nakama_tpu.matchmaker.local import (
+    ErrNotAvailable,
+    ErrTooManyTickets,
+    MatchmakerError,
+)
+from nakama_tpu.realtime import PresenceMeta, Stream, StreamMode
+
+LOG = quiet_logger()
+
+
+# ----------------------------------------------------------- frame codec
+
+
+def test_frame_codec_roundtrip_and_partial_reads():
+    pack, unpack = _codec("json")
+    frames = [
+        {"t": "hb", "s": "n1", "p": "", "d": {"seq": i}} for i in range(3)
+    ]
+    raw = b"".join(encode_frame(f, pack) for f in frames)
+    # Feed byte-by-byte: decode must only yield complete frames.
+    buf = bytearray()
+    got = []
+    for byte in raw:
+        buf.append(byte)
+        got.extend(decode_frames(buf, unpack, 1 << 20))
+    assert got == frames
+    assert not buf
+
+
+def test_frame_codec_oversize_is_loud():
+    pack, unpack = _codec("json")
+    raw = encode_frame({"t": "x", "d": {"blob": "a" * 100}}, pack)
+    with pytest.raises(Exception):
+        decode_frames(bytearray(raw), unpack, max_bytes=16)
+
+
+# --------------------------------------------------------- bus test rig
+
+
+async def _mk_bus(node, metrics=None):
+    bus = ClusterBus(node, "127.0.0.1:0", {}, LOG, metrics)
+    await bus.start()
+    return bus
+
+
+async def _link(*buses):
+    """Full-mesh add_peer wiring for port-0 test buses."""
+    for a in buses:
+        for b in buses:
+            if a is not b:
+                a.add_peer(b.node, f"127.0.0.1:{b.port}")
+
+
+async def _drain(seconds=0.3):
+    await asyncio.sleep(seconds)
+
+
+async def test_bus_send_recv_and_trace_propagation():
+    trace_api.TRACES.reset()
+    trace_api.TRACES.configure(enabled=True, sample_rate=1.0)
+    a = await _mk_bus("a")
+    b = await _mk_bus("b")
+    await _link(a, b)
+    got = []
+
+    def handler(src, body):
+        ids = trace_api.current_trace_ids()
+        got.append((src, body, ids[0] if ids else None))
+
+    b.on("test.ping", handler)
+    with trace_api.root_span("unit") as sp:
+        trace_id = sp.trace_id
+        assert a.send("b", "test.ping", {"x": 1})
+    await _drain()
+    assert got and got[0][0] == "a" and got[0][1] == {"x": 1}
+    # The bus hop continued the sender's trace id on the receiver.
+    assert got[0][2] == trace_id
+    # Unknown peer: dropped, not raised.
+    assert not a.send("nope", "test.ping", {})
+    await a.stop()
+    await b.stop()
+    trace_api.TRACES.reset()
+
+
+async def test_bus_handler_error_costs_frame_not_reader():
+    a = await _mk_bus("a")
+    b = await _mk_bus("b")
+    await _link(a, b)
+    got = []
+    b.on("boom", lambda src, d: 1 / 0)
+    b.on("ok", lambda src, d: got.append(d))
+    a.send("b", "boom", {})
+    a.send("b", "ok", {"i": 1})
+    await _drain()
+    assert got == [{"i": 1}]
+    await a.stop()
+    await b.stop()
+
+
+# ----------------------------------------------------------- membership
+
+
+async def test_membership_up_down_up_with_resync_callbacks():
+    a = await _mk_bus("a")
+    b = await _mk_bus("b")
+    await _link(a, b)
+    ma = Membership(a, LOG, heartbeat_ms=50, down_after_ms=200)
+    mb = Membership(b, LOG, heartbeat_ms=50, down_after_ms=200)
+    downs, ups = [], []
+    ma.on_peer_down.append(downs.append)
+    ma.on_peer_up.append(ups.append)
+    ma.start()
+    mb.start()
+    await _drain(0.4)
+    assert ma.is_up("b") and mb.is_up("a")
+    assert ups == ["b"]
+    # Silence b: stop its heartbeats + its bus.
+    mb.stop()
+    await b.stop()
+    await _drain(0.5)
+    assert not ma.is_up("b")
+    assert downs == ["b"]
+    ma.stop()
+    await a.stop()
+
+
+async def test_membership_forced_down_via_fault_point_and_signal():
+    a = await _mk_bus("a")
+    b = await _mk_bus("b")
+    await _link(a, b)
+    ma = Membership(a, LOG, heartbeat_ms=50, down_after_ms=10_000)
+    mb = Membership(b, LOG, heartbeat_ms=50, down_after_ms=10_000)
+    ma.start()
+    mb.start()
+    await _drain(0.3)
+    assert ma.is_up("b")
+    signal = cluster_peers_signal(ma)
+    from nakama_tpu import overload
+
+    assert signal() == overload.OK
+    # Drop-mode cluster.peer_down forces one down detection (chaos's
+    # handle on the sweep without killing a process).
+    with faults.armed_ctx("cluster.peer_down", mode="drop", count=1):
+        ma.sweep()
+    assert not ma.is_up("b")
+    assert signal() == overload.WARN  # local-only posture WARNs
+    # The next frame from b heals it.
+    await _drain(0.3)
+    assert ma.is_up("b")
+    assert signal() == overload.OK
+    ma.stop()
+    mb.stop()
+    await a.stop()
+    await b.stop()
+
+
+# ---------------------------------------------------- presence wrappers
+
+
+async def _mk_node(name, metrics=None):
+    """bus + registry + tracker + router for one in-process node."""
+    bus = await _mk_bus(name, metrics)
+    reg = ClusterSessionRegistry(LOG, metrics, bus=bus)
+    tracker = ClusterTracker(LOG, name, metrics, bus=bus)
+    router = ClusterMessageRouter(
+        LOG, reg, tracker, metrics, bus=bus, node=name
+    )
+    tracker.set_event_router(router.route_presence_event)
+    tracker.start()
+    return bus, reg, tracker, router
+
+
+async def test_presence_replicates_routes_and_sweeps():
+    bus_a, reg_a, tr_a, rt_a = await _mk_node("a")
+    bus_b, reg_b, tr_b, rt_b = await _mk_node("b")
+    await _link(bus_a, bus_b)
+    sa = FakeSession("sa", "ua")
+    sb = FakeSession("sb", "ub")
+    reg_a.add(sa)
+    reg_b.add(sb)
+    chat = Stream(StreamMode.CHANNEL, label="room")
+    tr_a.track("sa", chat, "ua", PresenceMeta(username="ua"))
+    tr_b.track("sb", chat, "ub", PresenceMeta(username="ub"))
+    await tr_a.drain()
+    await _drain()
+    await tr_b.drain()
+    # Both nodes hold the union view.
+    assert tr_a.count_by_stream(chat) == 2
+    assert tr_b.count_by_stream(chat) == 2
+    assert tr_a.remote_count() == 1 and tr_b.remote_count() == 1
+    # b's local client saw a's join as a channel presence event, and
+    # it was delivered ONCE (no bus echo of presence events).
+    joins = [
+        e
+        for e in sb.sent
+        if "channel_presence_event" in e
+        and any(
+            j.get("user_id") == "ua"
+            for j in e["channel_presence_event"].get("joins", ())
+        )
+    ]
+    assert len(joins) == 1, sb.sent
+    # Cross-node stream send: a → the whole room, b's session gets it.
+    rt_a.send_to_stream(chat, {"chat": {"msg": "hi"}})
+    await _drain()
+    assert any("chat" in e for e in sb.sent)
+    # Remote untrack replicates as a leave.
+    tr_b.untrack("sb", chat)
+    await tr_b.drain()
+    await _drain()
+    await tr_a.drain()
+    assert tr_a.count_by_stream(chat) == 1
+    leaves = [
+        e
+        for e in sa.sent
+        if "channel_presence_event" in e
+        and e["channel_presence_event"].get("leaves")
+    ]
+    assert leaves
+    # Re-join then SWEEP b as dead: leave events fire on a.
+    tr_b.track("sb", chat, "ub", PresenceMeta(username="ub"))
+    await tr_b.drain()
+    await _drain()
+    sa.sent.clear()
+    swept = tr_a.sweep_node("b")
+    await tr_a.drain()
+    assert swept == 1
+    assert tr_a.count_by_stream(chat) == 1
+    assert tr_a.remote_count() == 0
+    assert any(
+        "channel_presence_event" in e
+        and e["channel_presence_event"].get("leaves")
+        for e in sa.sent
+    )
+    tr_a.stop()
+    tr_b.stop()
+    await bus_a.stop()
+    await bus_b.stop()
+
+
+async def test_presence_sync_diffs_on_peer_up():
+    bus_a, reg_a, tr_a, rt_a = await _mk_node("a")
+    bus_b, reg_b, tr_b, rt_b = await _mk_node("b")
+    await _link(bus_a, bus_b)
+    st = Stream(StreamMode.STATUS, subject="ua")
+    tr_a.track("sa", st, "ua", PresenceMeta(username="ua"))
+    # b missed the live event (booted later): apply the snapshot.
+    tr_b._on_remote_sync("a", {"presences": tr_a.local_presences()})
+    assert tr_b.count_by_stream(st) == 1
+    # Second identical sync: no duplicate events, view unchanged.
+    tr_b._on_remote_sync("a", {"presences": tr_a.local_presences()})
+    assert tr_b.count_by_stream(st) == 1
+    # a's presence vanished before the next sync: b diffs it out.
+    tr_b._on_remote_sync("a", {"presences": []})
+    assert tr_b.count_by_stream(st) == 0
+    tr_a.stop()
+    tr_b.stop()
+    await bus_a.stop()
+    await bus_b.stop()
+
+
+# -------------------------------------------------- matchmaker fan-in
+
+
+def _mm_cfg():
+    return MatchmakerConfig(
+        backend="cpu", pool_capacity=64, max_tickets=2
+    )
+
+
+async def _mk_matchmaker_pair():
+    """Owner node 'o' with a real LocalMatchmaker + ingest; frontend
+    'f' with the client proxy. Returns the whole rig."""
+    bus_o, reg_o, tr_o, rt_o = await _mk_node("o")
+    bus_f, reg_f, tr_f, rt_f = await _mk_node("f")
+    await _link(bus_o, bus_f)
+    mo = Membership(bus_o, LOG, heartbeat_ms=50, down_after_ms=300)
+    mf = Membership(bus_f, LOG, heartbeat_ms=50, down_after_ms=300)
+    mo.start()
+    mf.start()
+    mm = LocalMatchmaker(LOG, _mm_cfg(), node="o")
+    ingest = ClusterMatchmakerIngest(mm, bus_o, LOG)
+    mm.on_matched = cluster_matched_handler(
+        make_matched_handler(LOG, rt_o, "o", "key"),
+        bus_o,
+        mo,
+        "o",
+        LOG,
+    )
+    client = ClusterMatchmakerClient(
+        LOG, _mm_cfg(), bus_f, mf, "f", "o"
+    )
+    await _drain(0.3)  # membership convergence
+    return {
+        "buses": (bus_o, bus_f),
+        "members": (mo, mf),
+        "trackers": (tr_o, tr_f),
+        "routers": (rt_o, rt_f),
+        "regs": (reg_o, reg_f),
+        "mm": mm,
+        "ingest": ingest,
+        "client": client,
+    }
+
+
+async def _teardown(rig):
+    for m in rig["members"]:
+        m.stop()
+    for t in rig["trackers"]:
+        t.stop()
+    for b in rig["buses"]:
+        await b.stop()
+
+
+async def test_fan_in_add_match_publish_back_and_bookkeeping():
+    rig = await _mk_matchmaker_pair()
+    mm, client = rig["mm"], rig["client"]
+    reg_o, reg_f = rig["regs"]
+    so = FakeSession("so", "uo")
+    sf = FakeSession("sf", "uf")
+    reg_o.add(so)
+    reg_f.add(sf)
+    # Local ticket on the owner + forwarded ticket from the frontend.
+    mm.add([MatchmakerPresence("uo", "so")], "so", "", "*", 2, 2)
+    tid, _ = client.add(
+        [MatchmakerPresence("uf", "sf", node="f")], "sf", "", "*", 2, 2
+    )
+    assert tid.endswith(".f")  # the node-stamped ID seam
+    await _drain()
+    assert len(mm) == 2
+    assert mm.store.get(tid) is not None  # origin identity preserved
+    assert len(client) == 1
+    mm.process()
+    await _drain()
+    # Both sessions saw matchmaker_matched; the frontend's via the bus.
+    assert any("matchmaker_matched" in e for e in so.sent)
+    matched_f = [e for e in sf.sent if "matchmaker_matched" in e]
+    assert matched_f and matched_f[0]["matchmaker_matched"][
+        "ticket"
+    ] == tid
+    # mm.matched released the frontend's bookkeeping.
+    assert len(client) == 0
+    await _teardown(rig)
+
+
+async def test_client_enforces_sync_contract_and_owner_rejects():
+    rig = await _mk_matchmaker_pair()
+    client = rig["client"]
+    p = MatchmakerPresence("uf", "sf", node="f")
+    with pytest.raises(MatchmakerError):
+        client.add([p], "sf", "", "*", 0, 2)  # bad counts
+    with pytest.raises(MatchmakerError):
+        client.add([], "sf", "", "*", 2, 2)
+    client.add([p], "sf", "", "*", 2, 2)
+    client.add([p], "sf", "", "*", 2, 2)
+    with pytest.raises(ErrTooManyTickets):
+        client.add([p], "sf", "", "*", 2, 2)  # max_tickets=2 locally
+    # Owner-side authoritative rejection flows back as mm.reject and
+    # releases the client's bookkeeping: exceed the owner's cap with a
+    # forged third ticket (bypassing the local check).
+    await _drain()
+    client._session.clear()
+    client.add([p], "sf", "", "*", 2, 2)
+    await _drain()
+    assert len(client) == 2  # third add rejected by the owner
+    assert rig["mm"].store.session_ticket_count("sf") == 2
+    await _teardown(rig)
+
+
+async def test_client_degrades_when_owner_down_and_session_close_forwards():
+    rig = await _mk_matchmaker_pair()
+    client, mm = rig["client"], rig["mm"]
+    mo, mf = rig["members"]
+    p = MatchmakerPresence("uf", "sf", node="f")
+    tid, _ = client.add([p], "sf", "", "+properties.x:never", 2, 2)
+    await _drain()
+    assert len(mm) == 1
+    # Socket-close path: remove_session_all forwards to the owner.
+    client.remove_session_all("sf")
+    await _drain()
+    assert len(mm) == 0 and len(client) == 0
+    # Owner marked down: adds refuse synchronously (degrade, no hang).
+    mf._transition("o", "down")
+    with pytest.raises(ErrNotAvailable):
+        client.add([p], "sf", "", "*", 2, 2)
+    await _teardown(rig)
+
+
+async def test_owner_sweeps_dead_frontend_tickets_and_journals_unpublished(
+    tmp_path,
+):
+    from nakama_tpu.recovery import TicketJournal
+    from nakama_tpu.storage.db import Database
+
+    rig = await _mk_matchmaker_pair()
+    mm, client = rig["mm"], rig["client"]
+    mo, _ = rig["members"]
+    db = Database(str(tmp_path / "j.db"), read_pool_size=1)
+    await db.connect()
+    journal = TicketJournal(db, LOG)
+    mm.journal = journal
+    reg_o = rig["regs"][0]
+    so1 = FakeSession("so1", "uo1")
+    so2 = FakeSession("so2", "uo2")
+    reg_o.add(so1)
+    reg_o.add(so2)
+    # Cohort A: cross-node (f origin) — zone:x so it pairs with the
+    # owner-local zone:x ticket. Cohort B: owner-local zone:y pair.
+    p1 = MatchmakerPresence("uf", "sf", node="f")
+    held_tid, _ = client.add(
+        [p1], "sf", "", "+properties.zone:x", 2, 2,
+        string_properties={"zone": "x"},
+    )
+    mm.add(
+        [MatchmakerPresence("uo", "so")], "so", "",
+        "+properties.zone:x", 2, 2, 1, {"zone": "x"},
+    )
+    mm.add(
+        [MatchmakerPresence("uo1", "so1")], "so1", "",
+        "+properties.zone:y", 2, 2, 1, {"zone": "y"},
+    )
+    mm.add(
+        [MatchmakerPresence("uo2", "so2")], "so2", "",
+        "+properties.zone:y", 2, 2, 1, {"zone": "y"},
+    )
+    await _drain()
+    assert len(mm) == 4
+    # The frontend dies between add and match: per-cohort publish —
+    # cohort A (dead origin) journals `unpublished`, cohort B (all
+    # origins local) DELIVERS and journals `matched`.
+    mo._transition("f", "down")
+    mm.process()
+    await _drain()
+    assert await journal.flush()
+    rows = await db.fetch_all(
+        "SELECT op, payload FROM matchmaker_journal ORDER BY lsn"
+    )
+    ops = [r["op"] for r in rows]
+    assert "unpublished" in ops, ops
+    assert "matched" in ops, ops
+    import json as _json
+
+    for r in rows:
+        payload = _json.loads(r["payload"])
+        if r["op"] == "unpublished":
+            held = {t["ticket"] for t in payload["tickets"]}
+            assert held_tid in held and len(held) == 2  # cohort A only
+        if r["op"] == "matched":
+            assert held_tid not in set(payload["tickets"])
+    # The healthy local cohort's players saw their match.
+    assert any("matchmaker_matched" in e for e in so1.sent)
+    assert any("matchmaker_matched" in e for e in so2.sent)
+    # And the owner sweep drops the dead node's tickets from the pool.
+    mm.add(
+        [MatchmakerPresence("uf2", "sf2", node="f")],
+        "sf2", "", "+properties.x:never", 2, 2,
+        ticket_id="t-foreign.f",
+    )
+    assert len(mm) == 1
+    mm.remove_all("f")
+    assert len(mm) == 0
+    await db.close()
+    await _teardown(rig)
+
+
+async def test_cross_node_disconnect_broadcast():
+    bus_a, reg_a, tr_a, rt_a = await _mk_node("a")
+    bus_b, reg_b, tr_b, rt_b = await _mk_node("b")
+    await _link(bus_a, bus_b)
+    sb = FakeSession("sb", "ub")
+    reg_b.add(sb)
+    # a doesn't hold sb: the disconnect broadcasts and b closes it.
+    assert not await reg_a.disconnect("sb", "single session")
+    await _drain()
+    assert sb.closed
+    tr_a.stop()
+    tr_b.stop()
+    await bus_a.stop()
+    await bus_b.stop()
+
+
+# ------------------------------------------------------- the bench gate
+
+
+def test_cluster_regression_gate_units():
+    import bench
+
+    # Green run.
+    reasons, reg = bench.cluster_regression(
+        1000.0, 1200.0, 0, 0, 0, chat_delivered=True, healed=True
+    )
+    assert not reg and not reasons
+    # Each failure mode names itself.
+    reasons, reg = bench.cluster_regression(
+        1000.0, 1600.0, 0, 0, 0
+    )
+    assert reg and any("p99" in r for r in reasons)
+    reasons, reg = bench.cluster_regression(1000.0, 1000.0, 2, 0, 0)
+    assert reg and any("lost_tickets" in r for r in reasons)
+    reasons, reg = bench.cluster_regression(1000.0, 1000.0, 0, 3, 0)
+    assert reg and any("unswept" in r for r in reasons)
+    reasons, reg = bench.cluster_regression(1000.0, 1000.0, 0, 0, 1)
+    assert reg and any("hung" in r for r in reasons)
+    reasons, reg = bench.cluster_regression(
+        1000.0, 1000.0, 0, 0, 0, chat_delivered=False
+    )
+    assert reg and any("chat" in r for r in reasons)
+    reasons, reg = bench.cluster_regression(
+        1000.0, 1000.0, 0, 0, 0, healed=False
+    )
+    assert reg and any("matching" in r for r in reasons)
+    reasons, reg = bench.cluster_regression(
+        1000.0, 1000.0, 0, 0, 0, party_replicated=False
+    )
+    assert reg and any("party" in r for r in reasons)

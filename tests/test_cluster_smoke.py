@@ -1,0 +1,181 @@
+"""Tier-1 cluster smoke: 3 real nodes on loopback, one SIGKILL.
+
+The full cluster proof (`bench.py --cluster`) soaks traffic and gates
+the p99 ratio; THIS smoke pins the structural properties in tier-1 so
+a regression fails CI, not a bench round later:
+
+- three NakamaServer processes (device-owner + 2 frontends) boot with
+  `cluster.enabled` and converge to all-peers-up;
+- cross-node chat: a channel message sent on one frontend reaches a
+  member on the other;
+- fan-in matchmaking: a 1v1 pair split across the two frontends
+  matches through the owner's pool, each side receiving
+  `matchmaker_matched` (the forwarded ticket id carries its origin
+  node suffix);
+- SIGKILL of one frontend: within the heartbeat timeout the survivors
+  sweep its presences (leave events observed on the other frontend)
+  and the owner sweeps its pooled tickets (re-pool/remove audit);
+- heal: a fresh pair keeps matching after the kill.
+
+Subprocess-isolated like test_crash_smoke / test_fault_smoke: SIGKILL
+is the test, and each node must be its own process — that IS the
+subsystem under test. Children run `bench.py --cluster-node` (the same
+node runner the bench soak uses, so the lab and the proof cannot
+drift)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import tempfile
+import time
+
+import bench
+
+
+def test_cluster_three_nodes_chat_match_kill():
+    asyncio.run(asyncio.wait_for(_smoke(), timeout=170))
+
+
+async def _smoke():
+    import aiohttp
+
+    base_dir = tempfile.mkdtemp(prefix="cluster-smoke-")
+    owner = bench._ClusterNode(
+        "owner", "device_owner", "owner", [], base_dir,
+        db=os.path.join(base_dir, "owner.db"),
+        heartbeat_ms=200, down_after_ms=1200,
+    )
+    f1 = bench._ClusterNode(
+        "f1", "frontend", "owner", [], base_dir,
+        heartbeat_ms=200, down_after_ms=1200,
+    )
+    f2 = bench._ClusterNode(
+        "f2", "frontend", "owner", [], base_dir,
+        heartbeat_ms=200, down_after_ms=1200,
+    )
+    nodes = {n.name: n for n in (owner, f1, f2)}
+    for n in nodes.values():
+        n.spec["peers"] = [
+            f"{p.name}=127.0.0.1:{p.bus_port}"
+            for p in nodes.values()
+            if p is not n
+        ]
+        n.spawn()
+    clients = []
+    try:
+        async with aiohttp.ClientSession() as http:
+            for n in nodes.values():
+                await n.wait_healthy(http)
+            await bench._cluster_wait_converged(
+                http, list(nodes.values())
+            )
+
+            a = await bench._WsClient("a").open(
+                http, f1.base, "smoke-cl-alpha-0001"
+            )
+            b = await bench._WsClient("b").open(
+                http, f2.base, "smoke-cl-bravo-0001"
+            )
+            clients += [a, b]
+
+            # ---- cross-node chat -------------------------------------
+            ids = {}
+            for c in (a, b):
+                await c.send(
+                    {"channel_join": {"type": 1, "target": "lab"}}
+                )
+                ack = await c.recv_until("channel", 15.0)
+                assert ack is not None, f"{c.name}: no channel ack"
+                ids[c.name] = ack["channel"]["id"]
+            await b.send(
+                {
+                    "channel_message_send": {
+                        "channel_id": ids["b"],
+                        "content": json.dumps({"hello": "x-node"}),
+                    }
+                }
+            )
+            msg = await a.recv_until("channel_message", 15.0)
+            assert msg is not None, "cross-node chat not delivered"
+
+            # ---- one add→matched cycle across nodes ------------------
+            lat, hung = await bench._cluster_match_rounds(
+                [(a, b)], 1, timeout=20.0
+            )
+            assert hung == 0 and len(lat) == 2, (lat, hung)
+            # The forwarded ids carry their origin node: the seam.
+            assert any(t.endswith(".f1") for t in a.acked_tickets)
+            assert any(t.endswith(".f2") for t in b.acked_tickets)
+
+            # ---- pooled tickets on f2, then SIGKILL it ---------------
+            for j in range(2):
+                await b.send(
+                    {
+                        "matchmaker_add": {
+                            "query": f"+properties.never:zz{j}",
+                            "min_count": 2,
+                            "max_count": 2,
+                            "string_properties": {"mode": f"aa{j}"},
+                        }
+                    }
+                )
+                assert (
+                    await b.recv_until("matchmaker_ticket", 15.0)
+                ) is not None
+            await asyncio.sleep(1.0)  # forwards land at the owner
+            before = await bench._cluster_console(http, owner)
+            assert before["matchmaker_tickets"] >= 2
+            assert before["presences_remote"] > 0
+
+            f2.kill(signal.SIGKILL)
+
+            # Survivors sweep within down_after + slack: the owner's
+            # remote-presence view and pool both drop, and f1 sees the
+            # dead node's channel presence LEAVE.
+            deadline = time.perf_counter() + 15.0
+            swept = False
+            while time.perf_counter() < deadline and not swept:
+                snap = await bench._cluster_console(http, owner)
+                swept = (
+                    snap["membership"]["state"].get("f2") == "down"
+                    and snap["matchmaker_tickets"]
+                    <= before["matchmaker_tickets"] - 2
+                )
+                if not swept:
+                    await asyncio.sleep(0.25)
+            assert swept, "owner never swept the dead frontend"
+            leave = None
+            t_end = time.perf_counter() + 10.0
+            while leave is None and time.perf_counter() < t_end:
+                ev = await a.recv_until(
+                    "channel_presence_event", 1.0
+                )
+                if ev is not None and ev[
+                    "channel_presence_event"
+                ].get("leaves"):
+                    leave = ev
+            assert leave is not None, (
+                "no presence leave for the killed node's member"
+            )
+
+            # ---- heal: a fresh pair still matches --------------------
+            c = await bench._WsClient("c").open(
+                http, f1.base, "smoke-cl-heal-0001"
+            )
+            d = await bench._WsClient("d").open(
+                http, owner.base, "smoke-cl-heal-0002"
+            )
+            clients += [c, d]
+            lat2, hung2 = await bench._cluster_match_rounds(
+                [(c, d)], 1, timeout=20.0
+            )
+            assert hung2 == 0 and len(lat2) == 2, (lat2, hung2)
+
+            for cl in clients:
+                await cl.close()
+    finally:
+        for n in nodes.values():
+            n.stop()

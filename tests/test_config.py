@@ -70,6 +70,51 @@ def test_check_warnings_and_errors():
         cfg.check()
 
 
+def test_node_name_id_separator_rejected():
+    """The node name is embedded in presence/ticket/match IDs with '.'
+    as the separator — a hostile name like "evil.node" corrupts ID
+    parsing at the clustering seam. check() must reject it loudly."""
+    for bad in ("evil.node", "node name", "a/b", "naka:ma", "", "né"):
+        cfg = Config()
+        cfg.name = bad
+        with pytest.raises(ValueError, match="name"):
+            cfg.check()
+    for good in ("n1", "node-2", "Node_3", "nakama-tpu"):
+        cfg = Config()
+        cfg.name = good
+        cfg.check()  # no raise
+
+
+def test_parse_args_hostname_fallback_sanitized():
+    cfg = parse_args(["--name", ""])
+    # Whatever the hostname was, the fallback must be ID-safe.
+    import re
+
+    assert re.fullmatch(r"[A-Za-z0-9_-]+", cfg.name)
+    cfg.check()
+
+
+def test_cluster_config_check():
+    cfg = Config()
+    cfg.cluster.enabled = True
+    cfg.cluster.role = "frontend"
+    cfg.cluster.peers = ["owner=127.0.0.1:7353"]
+    with pytest.raises(ValueError, match="device_owner"):
+        cfg.check()  # frontend must name the owner among its peers
+    cfg.cluster.device_owner = "owner"
+    cfg.check()
+    cfg.cluster.peers = ["owner=127.0.0.1:7353", "owner=127.0.0.1:7354"]
+    with pytest.raises(ValueError, match="unique"):
+        cfg.check()
+    cfg.cluster.peers = ["bad.name=127.0.0.1:7353"]
+    with pytest.raises(ValueError, match="A-Za-z0-9"):
+        cfg.check()
+    cfg.cluster.peers = ["owner=127.0.0.1:7353"]
+    cfg.cluster.down_after_ms = cfg.cluster.heartbeat_ms
+    with pytest.raises(ValueError, match="down_after_ms"):
+        cfg.check()
+
+
 def test_parse_args_config_flag(tmp_path):
     p = tmp_path / "c.yml"
     p.write_text("name: n1\n")

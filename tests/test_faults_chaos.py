@@ -1032,3 +1032,192 @@ async def test_leaderboard_drop_faults_serve_stale_then_converge():
     assert engine.get_many("d", 0.0, owners) == oracle.get_many(
         "d", 0.0, owners
     )
+
+
+# ------------------------------------------------- cluster fault points
+
+
+async def _cluster_rig():
+    """Owner + frontend on loopback: real bus, membership, fan-in
+    matchmaker — the smallest rig the three cluster points fire on."""
+    from nakama_tpu.cluster import (
+        ClusterBus,
+        ClusterMatchmakerClient,
+        ClusterMatchmakerIngest,
+        Membership,
+    )
+
+    log = quiet_logger()
+    cfg = MatchmakerConfig(backend="cpu", pool_capacity=64,
+                           max_tickets=64)
+    bus_o = ClusterBus("o", "127.0.0.1:0", {}, log)
+    bus_f = ClusterBus("f", "127.0.0.1:0", {}, log)
+    await bus_o.start()
+    await bus_f.start()
+    bus_o.add_peer("f", f"127.0.0.1:{bus_f.port}")
+    bus_f.add_peer("o", f"127.0.0.1:{bus_o.port}")
+    mem_o = Membership(bus_o, log, heartbeat_ms=50, down_after_ms=400)
+    mem_f = Membership(bus_f, log, heartbeat_ms=50, down_after_ms=400)
+    mem_o.start()
+    mem_f.start()
+    got = []
+    mm = LocalMatchmaker(log, cfg, node="o",
+                         on_matched=lambda b: got.extend(list(b)))
+    ingest = ClusterMatchmakerIngest(mm, bus_o, log)
+    client = ClusterMatchmakerClient(log, cfg, bus_f, mem_f, "f", "o")
+    for _ in range(40):
+        await asyncio.sleep(0.05)
+        if mem_f.is_up("o") and mem_o.is_up("f"):
+            break
+    assert mem_f.is_up("o") and mem_o.is_up("f")
+    return {
+        "buses": (bus_o, bus_f), "members": (mem_o, mem_f),
+        "mm": mm, "client": client, "ingest": ingest, "got": got,
+        "log": log,
+    }
+
+
+async def _cluster_rig_down(rig):
+    for m in rig["members"]:
+        m.stop()
+    for b in rig["buses"]:
+        await b.stop()
+
+
+def _cluster_pair(client, mm, i):
+    """One cross-node 1v1 pair: a forwarded ticket + a local one."""
+    client.add(
+        [MatchmakerPresence(f"cu{i}", f"cs{i}", node="f")],
+        f"cs{i}", "", "*", 2, 2,
+    )
+    mm.add([MatchmakerPresence(f"ou{i}", f"os{i}")], f"os{i}", "", "*",
+           2, 2)
+
+
+async def test_cluster_send_fault_degrades_sync_and_heals_to_parity():
+    from nakama_tpu.matchmaker.local import ErrNotAvailable
+
+    rig = await _cluster_rig()
+    mm, client = rig["mm"], rig["client"]
+    try:
+        # Armed raise-mode cluster.send, p=0.5 seeded: some adds refuse
+        # SYNCHRONOUSLY (the degradation contract), none hang, the
+        # interval loop keeps running throughout.
+        faults.arm("cluster.send", "raise", probability=0.5, seed=7)
+        refused = accepted = 0
+        for i in range(16):
+            try:
+                client.add(
+                    [MatchmakerPresence(f"u{i}", f"s{i}", node="f")],
+                    f"s{i}", "", "+properties.x:never", 2, 2,
+                )
+                accepted += 1
+            except ErrNotAvailable:
+                refused += 1
+            mm.process()  # interval loop never wedges while armed
+        assert refused > 0 and accepted > 0
+        assert faults.PLANE.fired.get("cluster.send", 0) >= refused
+        faults.disarm("cluster.send")
+        await asyncio.sleep(0.3)
+        # Heal to parity: accepted forwards all reached the pool, and a
+        # fresh cross-node pair matches end to end.
+        assert mm.store.session_ticket_count("s0") <= 1
+        pooled = len(mm)
+        assert pooled == accepted, (pooled, accepted)
+        _cluster_pair(client, mm, 99)
+        await asyncio.sleep(0.3)
+        mm.process()
+        assert any(
+            e.ticket.endswith(".f")
+            for entries in rig["got"]
+            for e in entries
+        ), rig["got"]
+    finally:
+        faults.disarm()
+        await _cluster_rig_down(rig)
+
+
+async def test_cluster_recv_fault_drops_frames_never_wedges_and_heals():
+    rig = await _cluster_rig()
+    mm, client = rig["mm"], rig["client"]
+    try:
+        # Drop-mode cluster.recv at the OWNER: forwarded adds are
+        # discarded at dispatch — the reader loop, membership, and the
+        # interval loop all survive.
+        faults.arm("cluster.recv", "drop", probability=0.7, seed=11)
+        for i in range(12):
+            client.add(
+                [MatchmakerPresence(f"r{i}", f"rs{i}", node="f")],
+                f"rs{i}", "", "+properties.x:never", 2, 2,
+            )
+        await asyncio.sleep(0.4)
+        mm.process()  # still alive
+        dropped_window = len(mm)
+        assert dropped_window < 12  # some frames really dropped
+        assert faults.PLANE.fired.get("cluster.recv", 0) > 0
+        faults.disarm("cluster.recv")
+        # Membership must have survived the armed window (heartbeats
+        # were dropped too) or healed by now.
+        for _ in range(20):
+            await asyncio.sleep(0.05)
+            if rig["members"][0].is_up("f"):
+                break
+        assert rig["members"][0].is_up("f")
+        # Heal to parity: a fresh pair matches.
+        rig["got"].clear()
+        _cluster_pair(client, mm, 77)
+        await asyncio.sleep(0.3)
+        mm.process()
+        assert rig["got"], "post-disarm pair did not match"
+    finally:
+        faults.disarm()
+        await _cluster_rig_down(rig)
+
+
+async def test_cluster_peer_down_fault_warns_ladder_and_sweeps():
+    from nakama_tpu import overload as overload_mod
+    from nakama_tpu.cluster import cluster_peers_signal
+    from nakama_tpu.overload import AdmissionController, OverloadController
+
+    rig = await _cluster_rig()
+    mm, client = rig["mm"], rig["client"]
+    mem_o = rig["members"][0]
+    try:
+        # A pooled foreign ticket + the PR 5 ladder wired to the
+        # cluster signal.
+        client.add(
+            [MatchmakerPresence("du", "ds", node="f")],
+            "ds", "", "+properties.x:never", 2, 2,
+        )
+        await asyncio.sleep(0.3)
+        assert len(mm) == 1
+        ladder = OverloadController(
+            AdmissionController(4, {}), None, recover_samples=1,
+            logger=rig["log"],
+        )
+        ladder.register_signal(
+            "cluster_peers", cluster_peers_signal(mem_o)
+        )
+        mem_o.on_peer_down.append(lambda peer: mm.remove_all(peer))
+        assert ladder.sample() == overload_mod.OK
+        # Drop-mode cluster.peer_down forces ONE down detection: the
+        # local-only posture WARNs the ladder and the owner sweeps the
+        # dead node's tickets.
+        faults.arm("cluster.peer_down", "drop", count=1)
+        mem_o.sweep()
+        assert not mem_o.is_up("f")
+        assert ladder.sample() == overload_mod.WARN
+        assert len(mm) == 0  # ticket swept with the node
+        # Heal: the next frame from f marks it up; the ladder recovers.
+        await asyncio.sleep(0.3)
+        assert mem_o.is_up("f")
+        assert ladder.sample() == overload_mod.OK
+        # The interval + delivery path still matches cross-node.
+        rig["got"].clear()
+        _cluster_pair(client, mm, 55)
+        await asyncio.sleep(0.3)
+        mm.process()
+        assert rig["got"]
+    finally:
+        faults.disarm()
+        await _cluster_rig_down(rig)

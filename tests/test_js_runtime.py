@@ -186,6 +186,37 @@ def test_js_no_ambient_capabilities():
     assert out == ["undefined"]
 
 
+def test_js_spread_in_call_position():
+    """TS-compiled-style module code (round-5 #9 subset): helpers that
+    re-emit `fn(...args)` — e.g. a logger shim or a Math.max over a
+    collected array — must run, not die at parse."""
+    out, _ = run(
+        """
+        // tsc output style: a var-arg forwarder over an array.
+        function sum() {
+          var total = 0;
+          for (var i = 0; i < arguments.length; i++) {
+            total += arguments[i];
+          }
+          return total;
+        }
+        var parts = [1, 2, 3];
+        console.log(sum(...parts));
+        console.log(sum(10, ...parts, ...[4, 5]));
+        console.log(Math.max(...parts, 7));
+        // Strings spread to chars (the other iterable this subset has).
+        function count() { return arguments.length; }
+        console.log(count(..."abc"));
+        """
+    )
+    assert out == ["6", "25", "7", "3"]
+
+
+def test_js_spread_of_non_iterable_is_loud():
+    with pytest.raises((JsRuntimeError, JsThrow)):
+        run("function f() {} f(...42);")
+
+
 def test_js_unsupported_syntax_is_loud():
     from nakama_tpu.runtime.js.lexer import JsSyntaxError
 

@@ -119,6 +119,12 @@ GOLDEN_EXPOSITION = {
     ("nakama_db_drain_restarts", "Counter", ("loop",)),
     ("nakama_db_group_commits", "Counter", ()),
     ("nakama_db_peak_concurrent_reads", "Gauge", ()),
+    ("nakama_cluster_bus_dropped", "Counter", ("reason",)),
+    ("nakama_cluster_bus_queue_depth", "Gauge", ("peer",)),
+    ("nakama_cluster_forwards", "Counter", ("op",)),
+    ("nakama_cluster_frames", "Counter", ("type", "direction")),
+    ("nakama_cluster_peers", "Gauge", ("state",)),
+    ("nakama_cluster_presence_sweeps", "Counter", ()),
     ("nakama_db_write_batch_size", "Histogram", ()),
     ("nakama_db_write_queue_depth", "Gauge", ()),
     ("nakama_device_kernel_time_sec", "Histogram", ("kernel",)),
