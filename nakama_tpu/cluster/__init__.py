@@ -19,6 +19,7 @@ No handler code changes: the wrappers implement the same surfaces.
 """
 
 from .bus import ClusterBus, ClusterPeerDown, decode_frames, encode_frame
+from .lease import FailoverMonitor, LeaseManager
 from .matchmaker import (
     ClusterMatchmakerClient,
     ClusterMatchmakerIngest,
@@ -32,6 +33,8 @@ from .presence import (
     ClusterStreamManager,
     ClusterTracker,
 )
+from .replication import JournalShipper, ReplicationApplier
+from .sharding import ShardDirectory, rendezvous_shard, shard_key
 
 __all__ = [
     "ClusterBus",
@@ -43,9 +46,16 @@ __all__ = [
     "ClusterSessionRegistry",
     "ClusterStreamManager",
     "ClusterTracker",
+    "FailoverMonitor",
+    "JournalShipper",
+    "LeaseManager",
     "Membership",
+    "ReplicationApplier",
+    "ShardDirectory",
     "cluster_matched_handler",
     "cluster_peers_signal",
     "decode_frames",
     "encode_frame",
+    "rendezvous_shard",
+    "shard_key",
 ]

@@ -1,0 +1,312 @@
+"""Lease-based shard ownership: renewal on heartbeats, standby
+promotion on expiry, epoch-fenced demotion.
+
+The lease protocol is deliberately tiny — it rides entirely on frames
+that already flow:
+
+- An owner RENEWS by including a claim ``{shard, node, epoch}`` in its
+  heartbeat payload (membership's `payload_hook`); every node folds
+  claims into its `ShardDirectory`. The `lease.renew` fault point sits
+  on claim emission, so chaos can silence an owner's lease without
+  touching its other traffic.
+- The configured standby (``cluster.standby_of``) watches the lease of
+  the ONE shard it shadows. Silence past ``lease_ms + lease_grace_ms``
+  is expiry: the standby promotes — detaches its replication applier
+  (the shadow pool is now THE pool), claims the shard at ``epoch + 1``,
+  starts the interval/delivery loops, checkpoints the adopted pool to
+  its own journal, and broadcasts an immediate heartbeat so frontends
+  re-route within one membership round.
+- Exactly-one-takeover falls out of the topology plus the epoch fence:
+  only the configured standby may promote for a shard (no election),
+  and a surviving old owner that sees the higher-epoch claim DEMOTES —
+  pauses its interval loop and stops renewing — because the directory
+  refuses its stale-epoch renewals everywhere anyway. Two nodes can
+  disagree for at most one membership round, during which the old
+  owner can still form matches but frontends already route adds (and
+  re-forwarded tickets) by the higher epoch."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from .. import faults
+from ..logger import Logger
+from .sharding import LEASE_EXPIRED, ShardDirectory
+
+
+class LeaseManager:
+    """Claim emitter for the shards this node currently owns. Wired as
+    (part of) membership's heartbeat payload; also self-renews the
+    local directory, since a node never hears its own heartbeats."""
+
+    def __init__(
+        self,
+        directory: ShardDirectory,
+        node: str,
+        shards_owned: list[str],
+        logger: Logger,
+        metrics=None,
+        boot_grace_rounds: int = 0,
+    ):
+        self.directory = directory
+        self.node = node
+        self.owned: set[str] = set(shards_owned)
+        self.logger = logger.with_fields(subsystem="cluster.lease")
+        self.metrics = metrics
+        self.demotions = 0
+        # Listen-before-claim: a RESTARTED owner's fresh directory is
+        # seeded at epoch 0, and an immediate self-claim at epoch 1
+        # could collide with a standby promoted to epoch 1 while it
+        # was dead — equal-epoch claims are refused both ways, a
+        # permanent split. A few silent heartbeat rounds let the
+        # fleet's current (higher-epoch) claims fold in first; the
+        # self-claim below is then REFUSED and this node stands down
+        # instead of dueling. The server wires this for owner boots;
+        # a promoted standby claims immediately (grace 0) so
+        # frontends re-route within one round.
+        self._grace_rounds = max(0, int(boot_grace_rounds))
+        directory.on_transition.append(self._on_transition)
+
+    def heartbeat_payload(self) -> dict:
+        """Claims for the heartbeat body. An armed drop-mode
+        `lease.renew` silences the renewal (the chaos handle for a
+        takeover without killing a process); raise-mode degrades to a
+        skipped round, never a dead heartbeat loop."""
+        if self._grace_rounds > 0 and self.owned:
+            self._grace_rounds -= 1
+            self.directory.publish_gauges()
+            return {}
+        claims = []
+        for shard in sorted(self.owned):
+            try:
+                if faults.fire("lease.renew"):
+                    continue  # renewal dropped: the lease decays
+            except Exception as e:
+                self.logger.warn("lease renew fault", error=str(e))
+                continue
+            epoch = max(1, self.directory.epoch_of(shard))
+            if not self.directory.claim(shard, self.node, epoch):
+                # Another node holds the shard at >= this epoch (we
+                # restarted through its takeover): demotion by
+                # refusal — never an equal-epoch duel.
+                self._stand_down(
+                    shard, *self.directory.owner_of(shard)
+                )
+                continue
+            claims.append(
+                {"shard": shard, "node": self.node, "epoch": epoch}
+            )
+        self.directory.publish_gauges()
+        return {"claims": claims} if claims else {}
+
+    def adopt(self, shard: str, epoch: int) -> None:
+        """Take ownership (promotion): claim at the new epoch and start
+        renewing it."""
+        self.owned.add(shard)
+        self.directory.claim(shard, self.node, epoch)
+
+    def _on_transition(
+        self, shard: str, old: str, new: str, epoch: int
+    ) -> None:
+        """A higher-epoch claim replaced US: stand down. The directory
+        already refuses our stale renewals cluster-wide; dropping the
+        shard here just stops us emitting them (and lets the plane
+        pause the interval loop via `on_demoted`)."""
+        if old == self.node and new != self.node and shard in self.owned:
+            self._stand_down(shard, new, epoch)
+
+    def _stand_down(self, shard: str, new_owner: str, epoch: int):
+        if shard not in self.owned:
+            return
+        self.owned.discard(shard)
+        self.demotions += 1
+        self.logger.warn(
+            "shard lease lost to a higher/equal epoch — demoting"
+            " (interval loop pauses; this node forms no further"
+            " matches for the shard)",
+            shard=shard, new_owner=new_owner, epoch=epoch,
+        )
+        if self.on_demoted is not None:
+            try:
+                self.on_demoted(shard, new_owner, epoch)
+            except Exception as e:
+                self.logger.error(
+                    "demotion hook error", error=str(e)
+                )
+
+    # Set by the plane: called with (shard, new_owner, epoch) when this
+    # node loses a shard it owned.
+    on_demoted = None
+
+    def stats(self) -> dict:
+        return {
+            "owned": sorted(self.owned),
+            "demotions": self.demotions,
+        }
+
+
+class FailoverMonitor:
+    """Standby-side watchdog for the one shard this node shadows.
+
+    Runs on the heartbeat cadence (its own task — promotion must not
+    depend on the owner's frames arriving). `check()` is the testable
+    core; promotion happens at most once per process."""
+
+    def __init__(
+        self,
+        directory: ShardDirectory,
+        lease: LeaseManager,
+        shard: str,
+        node: str,
+        logger: Logger,
+        *,
+        matchmaker=None,
+        applier=None,
+        recovery=None,
+        membership=None,
+        metrics=None,
+        heartbeat_s: float = 0.5,
+    ):
+        self.directory = directory
+        self.lease = lease
+        self.shard = shard
+        self.node = node
+        self.logger = logger.with_fields(subsystem="cluster.failover")
+        self.mm = matchmaker
+        self.applier = applier
+        self.recovery = recovery
+        self.membership = membership
+        self.metrics = metrics
+        self.heartbeat_s = heartbeat_s
+        self.promoted = False
+        self.promotions = 0
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                if self.applier is not None:
+                    self.applier.tick()
+                self.directory.publish_gauges()
+                if (
+                    not self.promoted
+                    and self.recovery is not None
+                    and self.mm is not None
+                ):
+                    # The shadow pool has no interval loop to ride, so
+                    # the checkpoint cadence lives here: without it the
+                    # standby re-journals every replicated op and its
+                    # journal grows with total ticket churn for its
+                    # whole tenure (and a standby restart would replay
+                    # that unbounded history). After promotion the
+                    # interval loop owns the cadence as usual.
+                    await self.recovery.checkpointer.maybe_checkpoint(
+                        self.mm
+                    )
+                if self.check():
+                    await self.promote("lease_expired")
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # The watchdog must survive anything promotion wiring
+                # throws — a failed promotion attempt retries next tick.
+                self.logger.error("failover monitor error", error=str(e))
+            await asyncio.sleep(self.heartbeat_s)
+
+    def check(self, now: float | None = None) -> bool:
+        """True when the shadowed shard's lease is expired past grace
+        and someone else still holds it — the promotion condition. The
+        lease alone decides: membership may still call a partitioned
+        owner UP on other traffic, but ownership is the lease, and the
+        epoch fence demotes the old owner when it hears the new map."""
+        if self.promoted:
+            return False
+        owner, epoch = self.directory.owner_of(self.shard)
+        if owner == self.node or not owner:
+            return False
+        if epoch < 1:
+            # Never heard a real claim for this shard (cold fleet
+            # boot, or this standby restarted while the owner is
+            # already gone): the seed entry's clock is OUR construction
+            # time, not evidence about the owner — promoting off it
+            # would race every multi-process boot. Documented posture:
+            # promotion requires at least one observed renewal.
+            return False
+        return self.directory.lease_state(self.shard, now) == LEASE_EXPIRED
+
+    async def promote(self, reason: str) -> None:
+        """The takeover: shadow pool becomes THE pool for the shard."""
+        if self.promoted:
+            return
+        self.promoted = True
+        self.promotions += 1
+        old_owner, old_epoch = self.directory.owner_of(self.shard)
+        epoch = old_epoch + 1
+        self.logger.warn(
+            "promoting standby to shard owner",
+            shard=self.shard, old_owner=old_owner, epoch=epoch,
+            reason=reason,
+            shadow_tickets=(
+                len(self.mm.store) if self.mm is not None else 0
+            ),
+        )
+        if self.metrics is not None:
+            try:
+                self.metrics.owner_takeovers.labels(reason=reason).inc()
+            except Exception:
+                pass
+        # Order matters: stop applying the dead owner's stream BEFORE
+        # the pool goes live (a zombie ship must not mutate it), claim
+        # + renew so frontends re-route, THEN start ticking.
+        if self.applier is not None:
+            self.applier.detach()
+        self.lease.adopt(self.shard, epoch)
+        if self.membership is not None:
+            try:
+                self.membership.beat_now()
+            except Exception:
+                pass
+        if self.mm is not None and getattr(self.mm, "_task", None) is None:
+            try:
+                self.mm.start()
+            except Exception as e:
+                self.logger.error(
+                    "promoted matchmaker failed to start", error=str(e)
+                )
+        # Settle the adopted pool into OUR durable story: one immediate
+        # checkpoint so a crash of the promoted owner replays nothing
+        # of the old owner's (its journal rows live in another node's
+        # namespace; re-pooled `unpublished` tickets are ordinary pool
+        # members here and the snapshot covers them).
+        if self.recovery is not None:
+            try:
+                await self.recovery.checkpointer.checkpoint(self.mm)
+            except Exception as e:
+                self.logger.warn(
+                    "post-promotion checkpoint failed (journal tail"
+                    " still covers the pool)", error=str(e),
+                )
+        self.logger.info(
+            "standby promoted; shard serving",
+            shard=self.shard, epoch=epoch,
+            tickets=len(self.mm.store) if self.mm is not None else 0,
+        )
+
+    def stats(self) -> dict:
+        return {
+            "shard": self.shard,
+            "promoted": self.promoted,
+            "promotions": self.promotions,
+            "lease": ("held", "grace", "expired")[
+                self.directory.lease_state(self.shard)
+            ],
+        }

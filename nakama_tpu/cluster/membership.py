@@ -46,6 +46,12 @@ class Membership:
         self.peer_info: dict[str, dict] = {}  # last heartbeat body
         self.on_peer_down: list[Callable[[str], None]] = []
         self.on_peer_up: list[Callable[[str], None]] = []
+        # Scale-out plane hooks: `payload_hook()` -> dict merged into
+        # every outbound heartbeat body (lease claims, standby
+        # announcements ride the frames that already flow);
+        # `on_heartbeat(src, body)` observers fold them back in.
+        self.payload_hook: Callable[[], dict] | None = None
+        self.on_heartbeat: list[Callable[[str, dict], None]] = []
         self._task: asyncio.Task | None = None
         self._hb_seq = 0
         bus.frame_hook = self.note_frame
@@ -92,6 +98,13 @@ class Membership:
 
     def _on_hb(self, src: str, body: dict):
         self.peer_info[src] = body
+        for cb in self.on_heartbeat:
+            try:
+                cb(src, body)
+            except Exception as e:
+                self.logger.error(
+                    "heartbeat observer error", peer=src, error=str(e)
+                )
 
     def _transition(self, peer: str, new: str):
         old = self.state.get(peer)
@@ -134,18 +147,25 @@ class Membership:
 
     # --------------------------------------------------------------- loop
 
+    def beat_now(self):
+        """Broadcast one heartbeat immediately (a promoted standby
+        announces its claim without waiting out the cadence)."""
+        self._hb_seq += 1
+        body = {"seq": self._hb_seq, "t": time.time()}
+        if self.payload_hook is not None:
+            try:
+                body.update(self.payload_hook() or {})
+            except Exception as e:
+                self.logger.error(
+                    "heartbeat payload hook error", error=str(e)
+                )
+        self.bus.broadcast("hb", body)
+
     async def _loop(self):
         self._publish_gauges()
         while True:
             try:
-                self._hb_seq += 1
-                self.bus.broadcast(
-                    "hb",
-                    {
-                        "seq": self._hb_seq,
-                        "t": time.time(),
-                    },
-                )
+                self.beat_now()
                 self.sweep()
             except asyncio.CancelledError:
                 raise

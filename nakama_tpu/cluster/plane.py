@@ -1,6 +1,22 @@
-"""Cluster plane assembly: bus + membership from config, plus the
-cross-cutting hooks (peer-up presence resync, peer-down sweeps, the
-overload ladder's local-only WARN signal)."""
+"""Cluster plane assembly: bus + membership + the owner scale-out
+plane (shard directory, lease claims, warm-standby replication) from
+config, plus the cross-cutting hooks (peer-up presence resync,
+peer-down sweeps, the overload ladder's local-only WARN signal).
+
+Roles:
+
+- ``device_owner`` — one shard of the owner fleet: runs the real
+  LocalMatchmaker + device pool + journal, claims its shard's lease on
+  every heartbeat, and ships its journal tail to a discovered standby.
+- ``standby`` — shadows ONE owner (``cluster.standby_of``): applies
+  the replicated journal into a non-ticking shadow pool and promotes
+  when the owner's lease expires past grace.
+- ``frontend`` — terminates sessions; routes matchmaker ops by the
+  epoch-versioned shard map and re-forwards retained tickets on a
+  takeover.
+
+A single-owner deployment (``cluster.shards`` empty) is the degenerate
+one-shard fleet: same code path, a map that never transitions."""
 
 from __future__ import annotations
 
@@ -8,7 +24,9 @@ from .. import overload
 from ..config import Config
 from ..logger import Logger
 from .bus import ClusterBus
+from .lease import FailoverMonitor, LeaseManager
 from .membership import Membership
+from .sharding import ShardDirectory
 
 
 def parse_peers(specs) -> dict[str, str]:
@@ -20,10 +38,11 @@ def parse_peers(specs) -> dict[str, str]:
 
 
 class ClusterPlane:
-    """Owns the bus and membership for one node. Components register
-    their bus handlers at construction; `wire_sweeps` binds the
-    death/recovery hooks once the tracker (and, on the owner, the
-    matchmaker) exist."""
+    """Owns the bus, membership and shard directory for one node.
+    Components register their bus handlers at construction;
+    `wire_sweeps` binds the death/recovery hooks and
+    `wire_matchmaker` binds the scale-out plane once the matchmaker
+    (and, on owners/standbys, the recovery plane) exist."""
 
     def __init__(self, config: Config, logger: Logger, metrics=None):
         cc = config.cluster
@@ -34,6 +53,7 @@ class ClusterPlane:
             config.name if cc.role == "device_owner" else ""
         )
         self.logger = logger.with_fields(subsystem="cluster")
+        self.metrics = metrics
         self.bus = ClusterBus(
             config.name,
             cc.bind,
@@ -53,22 +73,99 @@ class ClusterPlane:
             heartbeat_ms=cc.heartbeat_ms,
             down_after_ms=cc.down_after_ms,
         )
+        # The shard keyspace: the configured owner fleet, or the
+        # single-owner degenerate map (shard id == the owner's name; a
+        # standby in that deployment derives it from the owner it
+        # shadows — an empty directory could never fire failover).
+        shards = list(cc.shards) or (
+            [self.owner]
+            if self.owner
+            else [cc.standby_of] if cc.standby_of else []
+        )
+        self.directory = ShardDirectory(
+            self.node,
+            shards,
+            lease_ms=cc.lease_ms,
+            lease_grace_ms=cc.lease_grace_ms,
+            logger=self.logger,
+            metrics=metrics,
+        )
+        self.lease: LeaseManager | None = None
+        self.shipper = None
+        self.applier = None
+        self.monitor: FailoverMonitor | None = None
+        self._matchmaker = None
+        self._ingest = None
+        self.membership.payload_hook = self._hb_payload
+        self.membership.on_heartbeat.append(self._fold_hb)
 
     @property
     def is_owner(self) -> bool:
         return self.role == "device_owner"
 
-    def wire_sweeps(self, tracker, matchmaker=None):
+    @property
+    def is_standby(self) -> bool:
+        return self.role == "standby"
+
+    @property
+    def runs_pool(self) -> bool:
+        """Does this node host a (live or shadow) ticket pool?"""
+        return self.role in ("device_owner", "standby")
+
+    # --------------------------------------------------------- heartbeat
+
+    def _hb_payload(self) -> dict:
+        out: dict = {}
+        if self.lease is not None:
+            out.update(self.lease.heartbeat_payload())
+        if self.is_standby and not (
+            self.monitor is not None and self.monitor.promoted
+        ):
+            # Announce the shadow relationship: the owner's shipper
+            # discovers its standby from this, no owner-side config.
+            out["standby_of"] = self.config.cluster.standby_of
+        self.directory.publish_gauges()
+        if self.shipper is not None:
+            self.shipper.publish_gauges()
+        return out
+
+    def _fold_hb(self, src: str, body: dict) -> None:
+        for c in body.get("claims", ()):
+            try:
+                self.directory.claim(
+                    str(c["shard"]), str(c["node"]), int(c["epoch"])
+                )
+            except (KeyError, TypeError, ValueError):
+                continue
+        standby_of = body.get("standby_of")
+        if (
+            standby_of
+            and self.shipper is not None
+            and standby_of == self.node
+        ):
+            self.shipper.set_standby(src)
+
+    # ------------------------------------------------------------ wiring
+
+    def wire_sweeps(self, tracker, matchmaker=None, ingest=None):
         """Peer death: sweep its presences from this node's view (leave
-        events fire locally → match/party registries + clients); on the
+        events fire locally → match/party registries + clients); on an
         owner additionally sweep its tickets from the pool (journaled
-        removes — the PR 7 audit sees them). Peer recovery: push this
-        node's local-presence snapshot so the returning node rebuilds
-        its remote view."""
+        removes — the PR 7 audit sees them), epoch-fenced through the
+        ingest when sharding is live so a takeover re-forward survives
+        a stale down-observation. Peer recovery: push this node's
+        local-presence snapshot so the returning node rebuilds its
+        remote view."""
 
         def on_down(peer: str):
+            # Capture the epoch AT the down observation: tickets
+            # re-added later (takeover re-forwards racing this sweep)
+            # carry a higher stamp and are skipped.
+            epoch = self.directory.max_epoch()
             tracker.sweep_node(peer)
-            if matchmaker is not None:
+            if ingest is not None:
+                ingest.sweep_node(peer, epoch=epoch)
+            elif matchmaker is not None:
                 matchmaker.remove_all(peer)
 
         def on_up(peer: str):
@@ -79,29 +176,156 @@ class ClusterPlane:
         self.membership.on_peer_down.append(on_down)
         self.membership.on_peer_up.append(on_up)
 
+    def wire_matchmaker(self, matchmaker, ingest=None, recovery=None):
+        """Bind the scale-out plane to the (now-constructed) pool:
+        owners get a lease + the journal tail shipper; standbys get the
+        replication applier + the failover monitor. Frontends need
+        nothing here — their client registered on the directory at
+        construction."""
+        cc = self.config.cluster
+        self._matchmaker = matchmaker
+        self._ingest = ingest
+        if self.is_owner:
+            # An owner claims the shard named after itself (shard ids
+            # ARE the configured owner-fleet node names; the degenerate
+            # single-owner map follows the same rule).
+            owned = (
+                [self.node]
+                if self.node in self.directory.shards
+                else []
+            )
+            self.lease = LeaseManager(
+                self.directory,
+                self.node,
+                owned,
+                self.logger,
+                metrics=self.metrics,
+                # Listen-before-claim: a restart through a standby's
+                # takeover must fold the promoted epoch first and
+                # stand down, never mint an equal-epoch duel.
+                boot_grace_rounds=3,
+            )
+            self.lease.on_demoted = self._on_demoted
+            journal = getattr(recovery, "journal", None)
+            if journal is not None:
+                from .replication import JournalShipper
+
+                self.shipper = JournalShipper(
+                    journal,
+                    matchmaker,
+                    self.bus,
+                    self.node,
+                    self.logger,
+                    metrics=self.metrics,
+                )
+        elif self.is_standby:
+            from .replication import ReplicationApplier
+
+            shard = cc.standby_of
+            self.applier = ReplicationApplier(
+                matchmaker,
+                self.bus,
+                shard,
+                self.node,
+                self.logger,
+                metrics=self.metrics,
+            )
+            # The standby's lease manager owns nothing until promotion.
+            self.lease = LeaseManager(
+                self.directory, self.node, [], self.logger,
+                metrics=self.metrics,
+            )
+            self.lease.on_demoted = self._on_demoted
+            self.monitor = FailoverMonitor(
+                self.directory,
+                self.lease,
+                shard,
+                self.node,
+                self.logger,
+                matchmaker=matchmaker,
+                applier=self.applier,
+                recovery=recovery,
+                membership=self.membership,
+                metrics=self.metrics,
+                heartbeat_s=self.membership.heartbeat_s,
+            )
+
+    def _on_demoted(self, shard: str, new_owner: str, epoch: int):
+        """A higher epoch replaced us (we were partitioned through a
+        takeover): stop forming matches — frontends already route by
+        the new epoch, and the directory refuses our stale renewals
+        everywhere. Restart/operator intervention turns this node into
+        a standby replacement; automatic re-subordination is future
+        work (README documents the posture)."""
+        if self._matchmaker is not None:
+            try:
+                self._matchmaker.pause()
+            except Exception:
+                pass
+        self.logger.warn(
+            "this node was superseded as shard owner — matchmaking"
+            " paused (demoted posture)",
+            shard=shard, new_owner=new_owner, epoch=epoch,
+        )
+
+    # --------------------------------------------------------- lifecycle
+
+    def start_failover(self):
+        """Start the standby's failover watchdog — called AFTER the
+        server's warm restart, so a mid-recovery snapshot apply can
+        never interleave with the store restore. No-op elsewhere."""
+        if self.monitor is not None:
+            self.monitor.start()
+
     async def start(self):
         await self.bus.start()
         self.membership.start()
         self.logger.info(
             "cluster enabled",
             role=self.role,
-            owner=self.owner,
+            node=self.node,
             peers=sorted(self.bus.peers),
             heartbeat_ms=self.config.cluster.heartbeat_ms,
             down_after_ms=self.config.cluster.down_after_ms,
         )
+        # The resolved shard map in one boot line (PR 5 convention): an
+        # operator diagnosing routing reads shards → owner/epoch here.
+        self.logger.info(
+            "cluster shard map resolved",
+            shards={
+                s: f"{e['node']}@{e['epoch']}"
+                for s, e in self.directory.snapshot().items()
+            },
+            role=self.role,
+            standby_of=self.config.cluster.standby_of or None,
+            lease_ms=self.config.cluster.lease_ms,
+            lease_grace_ms=self.config.cluster.lease_grace_ms,
+        )
 
     async def stop(self):
+        if self.monitor is not None:
+            self.monitor.stop()
         self.membership.stop()
         await self.bus.stop()
 
     def stats(self) -> dict:
-        return {
+        out = {
             "role": self.role,
             "owner": self.owner,
             "bus": self.bus.stats(),
             "membership": self.membership.stats(),
+            "shards": self.directory.snapshot(),
+            "epoch": self.directory.max_epoch(),
         }
+        if self.lease is not None:
+            out["lease"] = self.lease.stats()
+        if self.shipper is not None:
+            out["replication"] = self.shipper.stats()
+        if self.applier is not None:
+            out["replication"] = self.applier.stats()
+        if self.monitor is not None:
+            out["failover"] = self.monitor.stats()
+        return out
 
 
 def cluster_peers_signal(membership):

@@ -1,0 +1,411 @@
+"""Warm-standby journal replication: owner journal tail → shadow pool.
+
+The PR 7 `TicketJournal` already serializes every ticket outcome into
+LSN-ordered rows; this module ships that tail over the PR 10 bus to a
+warm-standby owner, so failover is "start ticking" instead of "restore
+from disk somewhere else". Three frame types:
+
+- ``repl.ship`` — the owner's journal flush hook forwards each durable
+  batch (already-serialized rows, so shipping costs one list build —
+  the disarmed/no-standby hook is a single None check). Fire-and-forget
+  like every bus frame: a lost batch GROWS LAG, it never blocks the
+  flush.
+- ``repl.ack`` — the standby acknowledges its applied watermark; the
+  owner publishes `replication_lag_lsn`/`replication_lag_sec` from it.
+- ``repl.sync`` / ``repl.snapshot`` — catch-up: a standby that detects
+  a gap (lost ship, journal drop-mode, checkpoint truncation before it
+  ever connected, apply fault) requests a full pool snapshot, shipped
+  in bounded chunks ON THE SAME ordered peer link as subsequent ships,
+  so snapshot-then-tail needs no fencing.
+
+Apply is the `recover()` replay machinery on a live shadow pool:
+adds insert (duplicate-id guard absorbs re-delivery), remove/matched
+consume by id (no-op for unknown ids), `unpublished` re-pools full
+payloads — and the `(node, lsn)` watermark makes the whole stream
+idempotent: records at or below `applied_lsn` are skipped, exactly
+like a double recovery. Fault points `repl.ship` (owner, per batch)
+and `repl.apply` (standby, per batch) let chaos prove lag-grows-then-
+heals and degrade-not-wedge."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from .. import faults
+from ..logger import Logger
+from ..recovery import OP_ADD, OP_MATCHED, OP_REMOVE, OP_UNPUBLISHED
+
+SNAPSHOT_CHUNK = 500  # tickets per repl.snapshot frame (bounded frames)
+
+
+def extract_to_payload(ex) -> dict:
+    """MatchmakerExtract -> the journal's ticket payload shape
+    (recovery.ticket_payload's dual; payload_to_extract inverts it)."""
+    return {
+        "ticket": ex.ticket,
+        "query": ex.query,
+        "min_count": ex.min_count,
+        "max_count": ex.max_count,
+        "count_multiple": ex.count_multiple,
+        "session_id": ex.session_id,
+        "party_id": ex.party_id,
+        "presences": [
+            {
+                "user_id": p.user_id,
+                "session_id": p.session_id,
+                "username": p.username,
+                "node": p.node,
+            }
+            for p in ex.presences
+        ],
+        "string_properties": dict(ex.string_properties),
+        "numeric_properties": dict(ex.numeric_properties),
+        "created_at": ex.created_at,
+        "intervals": int(ex.intervals),
+        "embedding": (
+            None
+            if ex.embedding is None
+            else [float(x) for x in ex.embedding]
+        ),
+    }
+
+
+class JournalShipper:
+    """Owner side: hooks the journal's flush tail and streams batches
+    to the discovered standby. The standby is DISCOVERED, not
+    configured — it announces ``standby_of: <owner>`` in its heartbeat
+    payload and the plane binds it here — so the owner config carries
+    no replication knobs and a dead standby simply stops being
+    shipped to (lag gauges freeze at the last ack)."""
+
+    def __init__(self, journal, matchmaker, bus, node: str,
+                 logger: Logger, metrics=None):
+        self.journal = journal
+        self.mm = matchmaker
+        self.bus = bus
+        self.node = node
+        self.logger = logger.with_fields(subsystem="cluster.repl")
+        self.metrics = metrics
+        self.standby: str | None = None
+        self.acked_lsn = 0
+        self._acked_wall = 0.0
+        # Ledger totals (console/tests/bench).
+        self.shipped = 0
+        self.dropped = 0
+        self.snapshots = 0
+        journal.tail_hook = self.on_flush
+        bus.on("repl.ack", self._on_ack)
+        bus.on("repl.sync", self._on_sync)
+
+    def set_standby(self, node: str | None) -> None:
+        if node != self.standby:
+            self.standby = node
+            if node is not None:
+                self.logger.info(
+                    "warm standby attached; journal tail streaming",
+                    standby=node,
+                )
+
+    # ------------------------------------------------------------- ship
+
+    def on_flush(self, rows) -> None:
+        """Journal flush hook: `rows` are the drain's already-serialized
+        (lsn, op, payload_json, node, created_at) tuples. No standby =
+        one attribute check — the disarmed production posture the bench
+        budgets under 1% of the interval."""
+        if self.standby is None:
+            return
+        try:
+            if faults.fire("repl.ship"):
+                self.dropped += len(rows)
+                return
+            sent = self.bus.send(
+                self.standby,
+                "repl.ship",
+                {
+                    "records": [[r[0], r[1], r[2]] for r in rows],
+                    "t": time.time(),
+                },
+            )
+            if sent:
+                self.shipped += len(rows)
+            else:
+                self.dropped += len(rows)
+        except Exception as e:
+            # An armed raise-mode repl.ship (or a dying bus) costs this
+            # batch's replication, never the journal flush above it.
+            self.dropped += len(rows)
+            self.logger.warn("journal ship failed", error=str(e))
+
+    # -------------------------------------------------------- ack / lag
+
+    def _on_ack(self, src: str, d: dict) -> None:
+        if src != self.standby:
+            return
+        lsn = int(d.get("lsn", 0))
+        if lsn > self.acked_lsn:
+            self.acked_lsn = lsn
+            self._acked_wall = time.time()
+        self.publish_gauges(shipped_t=float(d.get("t", 0.0)))
+
+    def lag_lsn(self) -> int:
+        return max(0, self.journal.lsn - self.acked_lsn)
+
+    def lag_sec(self) -> float:
+        """Age of the replication backlog: 0 when the standby acked
+        everything durable; else wall time since the last ack made
+        progress (freezes rising while a standby is down)."""
+        if self.standby is None or self.lag_lsn() == 0:
+            return 0.0
+        if not self._acked_wall:
+            self._acked_wall = time.time()
+        return max(0.0, time.time() - self._acked_wall)
+
+    def publish_gauges(self, shipped_t: float = 0.0) -> None:
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.replication_lag_lsn.set(self.lag_lsn())
+            self.metrics.replication_lag_sec.set(self.lag_sec())
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- sync
+
+    def _on_sync(self, src: str, d: dict) -> None:
+        """Full-pool catch-up: chunked snapshot on the same ordered
+        peer link as later ships — the standby rebuilds, then the tail
+        continues seamlessly."""
+        if self.standby is None or src != self.standby:
+            # A sync request IS a standby announcing itself (boot-order
+            # race: the sync can beat the first heartbeat payload).
+            self.set_standby(src)
+        payloads = [extract_to_payload(ex) for ex in self.mm.extract()]
+        lsn = self.journal.lsn
+        chunks = [
+            payloads[i : i + SNAPSHOT_CHUNK]
+            for i in range(0, len(payloads), SNAPSHOT_CHUNK)
+        ] or [[]]
+        n = len(chunks)
+        for i, chunk in enumerate(chunks):
+            self.bus.send(
+                src,
+                "repl.snapshot",
+                {
+                    "seq": i,
+                    "n": n,
+                    "lsn": lsn,
+                    "tickets": chunk,
+                    "t": time.time(),
+                },
+            )
+        self.snapshots += 1
+        self.logger.info(
+            "replication snapshot shipped",
+            standby=src, tickets=len(payloads), lsn=lsn, chunks=n,
+        )
+
+    def stats(self) -> dict:
+        return {
+            "standby": self.standby,
+            "acked_lsn": self.acked_lsn,
+            "lag_lsn": self.lag_lsn(),
+            "lag_sec": round(self.lag_sec(), 3),
+            "shipped": self.shipped,
+            "dropped": self.dropped,
+            "snapshots": self.snapshots,
+        }
+
+
+class ReplicationApplier:
+    """Standby side: applies the owner's journal stream into the shadow
+    pool (a real, non-ticking LocalMatchmaker — same store, device
+    rows, duplicate guards as the owner's). Degradation posture: an
+    apply failure (armed `repl.apply`, a malformed record) costs that
+    batch and flags `need_sync`; the next tick requests a snapshot —
+    the stream NEVER wedges and the standby never poisons its pool
+    with a half-applied batch."""
+
+    def __init__(self, matchmaker, bus, owner: str, node: str,
+                 logger: Logger, metrics=None):
+        self.mm = matchmaker
+        self.bus = bus
+        self.owner = owner
+        self.node = node
+        self.logger = logger.with_fields(subsystem="cluster.repl")
+        self.metrics = metrics
+        self.applied_lsn = 0
+        self.synced = False
+        self.need_sync = True
+        self.active = True  # promotion flips this off: we ARE the owner
+        self._chunks: dict[int, list] = {}
+        self._chunk_lsn = 0
+        self._last_sync_req = 0.0
+        # Ledger totals.
+        self.applied = 0
+        self.skipped = 0
+        self.apply_failures = 0
+        bus.on("repl.ship", self._on_ship)
+        bus.on("repl.snapshot", self._on_snapshot)
+
+    # ------------------------------------------------------------ apply
+
+    def _apply_record(self, op: str, payload: dict) -> None:
+        from ..recovery import payload_to_extract
+
+        if op == OP_ADD:
+            self.mm.insert([payload_to_extract(payload)])
+        elif op in (OP_REMOVE, OP_MATCHED):
+            self.mm.remove(list(payload.get("tickets", ())))
+        elif op == OP_UNPUBLISHED:
+            self.mm.insert(
+                [
+                    payload_to_extract(p)
+                    for p in payload.get("tickets", ())
+                ]
+            )
+
+    def _on_ship(self, src: str, d: dict) -> None:
+        if not self.active or src != self.owner:
+            return
+        records = d.get("records") or []
+        try:
+            if faults.fire("repl.apply"):
+                raise faults.InjectedFault("repl.apply")
+        except Exception as e:
+            self.apply_failures += 1
+            self.need_sync = True
+            self.logger.warn(
+                "replication apply failed; will re-sync",
+                error=str(e), records=len(records),
+            )
+            return
+        fresh = [r for r in records if int(r[0]) > self.applied_lsn]
+        self.skipped += len(records) - len(fresh)
+        if not fresh:
+            self._ack(d.get("t", 0.0))
+            return
+        if int(fresh[0][0]) > self.applied_lsn + 1:
+            # A hole in the stream (lost ship / journal drop) — or a
+            # stream that began mid-journal (this standby attached
+            # after the owner had already flushed a prefix): applying
+            # past it could remove-before-add, and silently treating
+            # a late attach as synced would hide the missing prefix
+            # forever. Re-sync instead; the watermark holds the line.
+            self.need_sync = True
+            self.synced = False
+            self.logger.warn(
+                "replication gap detected; requesting snapshot",
+                have=self.applied_lsn, got=int(fresh[0][0]),
+            )
+            return
+        try:
+            for lsn, op, payload_json in fresh:
+                payload = (
+                    payload_json
+                    if isinstance(payload_json, dict)
+                    else json.loads(payload_json)
+                )
+                self._apply_record(op, payload)
+                self.applied_lsn = int(lsn)
+                self.applied += 1
+        except Exception as e:
+            self.apply_failures += 1
+            self.need_sync = True
+            self.logger.warn(
+                "replication apply failed mid-batch; will re-sync",
+                error=str(e),
+            )
+            return
+        self.synced = True
+        self.need_sync = False
+        self._ack(d.get("t", 0.0))
+
+    def _on_snapshot(self, src: str, d: dict) -> None:
+        if not self.active or src != self.owner:
+            return
+        seq, n = int(d.get("seq", 0)), int(d.get("n", 1))
+        lsn = int(d.get("lsn", 0))
+        if seq == 0 or lsn != self._chunk_lsn:
+            self._chunks = {}
+            self._chunk_lsn = lsn
+        self._chunks[seq] = d.get("tickets") or []
+        if len(self._chunks) < n:
+            return
+        # Full snapshot assembled: rebuild the shadow pool from scratch.
+        from ..recovery import payload_to_extract
+
+        try:
+            live = [t.ticket for t in self.mm.store.live_tickets()]
+            if live:
+                self.mm.remove(live)
+            payloads = [
+                p for i in sorted(self._chunks) for p in self._chunks[i]
+            ]
+            extracts = []
+            for p in payloads:
+                try:
+                    extracts.append(payload_to_extract(p))
+                except Exception as e:
+                    self.logger.warn(
+                        "snapshot payload dropped", error=str(e)
+                    )
+            if extracts:
+                self.mm.insert(extracts)
+            self.applied_lsn = lsn
+            self.synced = True
+            self.need_sync = False
+            self.applied += len(extracts)
+            self.logger.info(
+                "replication snapshot applied",
+                tickets=len(extracts), lsn=lsn,
+            )
+            self._ack(d.get("t", 0.0))
+        except Exception as e:
+            self.apply_failures += 1
+            self.need_sync = True
+            self.logger.warn(
+                "snapshot apply failed; will re-sync", error=str(e)
+            )
+        finally:
+            self._chunks = {}
+
+    def _ack(self, shipped_t) -> None:
+        self.bus.send(
+            self.owner,
+            "repl.ack",
+            {"lsn": self.applied_lsn, "t": shipped_t},
+        )
+
+    # ------------------------------------------------------------- tick
+
+    def tick(self) -> None:
+        """Heartbeat-cadence maintenance: request a snapshot when the
+        stream is broken or was never established (rate-limited — one
+        request per second, not one per tick)."""
+        if not self.active or not self.need_sync:
+            return
+        now = time.monotonic()
+        if now - self._last_sync_req < 1.0:
+            return
+        self._last_sync_req = now
+        self.bus.send(self.owner, "repl.sync", {})
+
+    def detach(self) -> None:
+        """Promotion: this node IS the owner now — stop applying (a
+        zombie old owner's late ships must not mutate the live pool)."""
+        self.active = False
+
+    def stats(self) -> dict:
+        return {
+            "owner": self.owner,
+            "active": self.active,
+            "applied_lsn": self.applied_lsn,
+            "synced": self.synced,
+            "need_sync": self.need_sync,
+            "applied": self.applied,
+            "skipped": self.skipped,
+            "apply_failures": self.apply_failures,
+            "shadow_tickets": len(self.mm.store),
+        }

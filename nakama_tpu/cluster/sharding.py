@@ -1,0 +1,233 @@
+"""Sharded ticket ownership: the deterministic map from ticket key to
+owner node, and the epoch-versioned directory every node folds lease
+claims into.
+
+Two layers, deliberately separated:
+
+- The **keyspace** is static: shard ids are the configured owner-fleet
+  node names (``cluster.shards``; a single-owner deployment is the
+  degenerate one-shard fleet). A ticket's key — its pool property when
+  set, else its query family — rendezvous-hashes over the shard ids,
+  so the key→shard assignment never moves while the fleet config is
+  stable. Pools are the unit of sharding because pools are the unit of
+  matching: tickets in different pools never form a cohort (the
+  ``cfg5_8x20k_multipool`` bench is exactly this batching), so a shard
+  is a self-contained matchmaking domain with its own device pool and
+  interval loop.
+
+- The **ownership** of each shard is dynamic and epoch-versioned: an
+  owner renews its claim on every heartbeat (lease.py), and a
+  promoted standby claims the same shard id at ``epoch + 1``. The
+  `ShardDirectory` on every node folds claims with a strict
+  highest-epoch-wins rule, so all nodes converge to the same map
+  within one membership round and a demoted owner's stale renewals
+  are refused — the split-brain fence is the epoch compare, not a
+  consensus round (exactly one node, the configured standby, may
+  mint the next epoch for a shard).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Callable
+
+# lease_state gauge encoding (metrics.py).
+LEASE_HELD = 0     # renewed within lease_ms
+LEASE_GRACE = 1    # silent past lease_ms, inside the grace window
+LEASE_EXPIRED = 2  # silent past lease_ms + lease_grace_ms: promotable
+
+
+def shard_key(query: str, string_properties=None) -> str:
+    """The ticket's routing key: the explicit ``pool`` string property
+    when the client set one, else the query itself (tickets that could
+    match each other share a query family; a pool property is the
+    multipool pattern's explicit handle). Deterministic and cheap —
+    computed on every frontend add."""
+    sp = string_properties or {}
+    return sp.get("pool") or query or "*"
+
+
+def rendezvous_shard(key: str, shards: list[str]) -> str:
+    """Highest-random-weight (rendezvous) hash of `key` over the static
+    shard-id list: every node computes the same winner with no shared
+    state, and removing one shard id only moves that shard's keys."""
+    if not shards:
+        raise ValueError("no shards configured")
+    if len(shards) == 1:
+        return shards[0]
+    best, best_w = shards[0], b""
+    for s in shards:
+        w = hashlib.md5(f"{s}\x00{key}".encode()).digest()
+        if w > best_w:
+            best, best_w = s, w
+    return best
+
+
+class ShardDirectory:
+    """Epoch-versioned shard→owner map, one per node.
+
+    Entries fold in from heartbeat lease claims (`claim`): a claim at a
+    higher epoch REPLACES the owner (a takeover — transition callbacks
+    fire so frontends re-route and a demoted owner can stand down); a
+    claim at the current epoch by the current owner RENEWS the lease;
+    anything else is refused (stale epoch = a demoted owner's zombie
+    renewal; same epoch, different node = a config error, never an
+    automatic replace). Seeded so shard ids own themselves at epoch 0:
+    a booting fleet routes immediately, before the first heartbeat."""
+
+    def __init__(
+        self,
+        node: str,
+        shards: list[str],
+        *,
+        lease_ms: int = 2000,
+        lease_grace_ms: int = 3000,
+        logger=None,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        self.node = node
+        self.shards = list(shards)
+        self.lease_s = max(0.001, lease_ms / 1000.0)
+        self.grace_s = max(0.001, lease_grace_ms / 1000.0)
+        self.logger = logger
+        self.metrics = metrics
+        self._clock = clock
+        # shard -> [owner node, epoch, last_renewed (monotonic)]
+        self._entries: dict[str, list] = {
+            s: [s, 0, clock()] for s in self.shards
+        }
+        # (shard, old_node, new_node, epoch) per ownership CHANGE.
+        self.on_transition: list[Callable[[str, str, str, int], None]] = []
+        self.takeovers = 0  # ledger total (console/tests)
+        self._publish_gauges()
+
+    # ----------------------------------------------------------- routing
+
+    def shard_for_key(self, key: str) -> str:
+        return rendezvous_shard(key, self.shards)
+
+    def owner_of(self, shard: str) -> tuple[str, int]:
+        e = self._entries.get(shard)
+        if e is None:
+            return ("", 0)
+        return (e[0], e[1])
+
+    def route(self, key: str) -> tuple[str, str, int]:
+        """key -> (shard, owner node, epoch)."""
+        shard = self.shard_for_key(key)
+        node, epoch = self.owner_of(shard)
+        return shard, node, epoch
+
+    def owners(self) -> list[str]:
+        """Distinct owner nodes across all shards (broadcast targets
+        for node-scoped removals)."""
+        return sorted({e[0] for e in self._entries.values() if e[0]})
+
+    def epoch_of(self, shard: str) -> int:
+        return self.owner_of(shard)[1]
+
+    def max_epoch(self) -> int:
+        return max(
+            (e[1] for e in self._entries.values()), default=0
+        )
+
+    def shards_owned_by(self, node: str) -> list[str]:
+        return sorted(
+            s for s, e in self._entries.items() if e[0] == node
+        )
+
+    # ------------------------------------------------------------ claims
+
+    def claim(self, shard: str, node: str, epoch: int) -> bool:
+        """Fold one lease claim. Returns True when accepted (renewal or
+        takeover). Epoch rules are strict — see the class docstring."""
+        e = self._entries.get(shard)
+        if e is None:
+            return False  # unknown shard id: not part of the keyspace
+        cur_node, cur_epoch, _ = e
+        if epoch < cur_epoch:
+            return False  # stale claim (a demoted owner's zombie renewal)
+        if epoch == cur_epoch:
+            if node != cur_node:
+                if self.logger is not None:
+                    self.logger.warn(
+                        "refused equal-epoch shard claim from a"
+                        " different node (config error?)",
+                        shard=shard, claimed_by=node,
+                        owner=cur_node, epoch=epoch,
+                    )
+                return False
+            e[2] = self._clock()  # renewal
+            self._publish_gauges()
+            return True
+        # Higher epoch: a takeover (or this node's own promotion).
+        e[0], e[1], e[2] = node, epoch, self._clock()
+        if node != cur_node:
+            self.takeovers += 1
+            if self.logger is not None:
+                self.logger.warn(
+                    "shard ownership transition",
+                    shard=shard, old=cur_node, new=node, epoch=epoch,
+                )
+            for cb in self.on_transition:
+                try:
+                    cb(shard, cur_node, node, epoch)
+                except Exception as exc:
+                    if self.logger is not None:
+                        self.logger.error(
+                            "shard transition callback error",
+                            shard=shard, error=str(exc),
+                        )
+        self._publish_gauges()
+        return True
+
+    # ------------------------------------------------------------- lease
+
+    def lease_state(self, shard: str, now: float | None = None) -> int:
+        e = self._entries.get(shard)
+        if e is None:
+            return LEASE_EXPIRED
+        now = self._clock() if now is None else now
+        silent = now - e[2]
+        if silent <= self.lease_s:
+            return LEASE_HELD
+        if silent <= self.lease_s + self.grace_s:
+            return LEASE_GRACE
+        return LEASE_EXPIRED
+
+    # ------------------------------------------------------------- misc
+
+    def _publish_gauges(self):
+        if self.metrics is None:
+            return
+        try:
+            for s, e in self._entries.items():
+                self.metrics.cluster_shard_owner.labels(shard=s).set(
+                    e[1]
+                )
+                self.metrics.lease_state.labels(shard=s).set(
+                    self.lease_state(s)
+                )
+        except Exception:
+            pass  # observability must never break routing
+
+    def publish_gauges(self):
+        """Refresh the lease_state gauges (called on the heartbeat
+        cadence — lease decay is time-driven, not event-driven)."""
+        self._publish_gauges()
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        return {
+            s: {
+                "node": e[0],
+                "epoch": e[1],
+                "lease": ("held", "grace", "expired")[
+                    self.lease_state(s, now)
+                ],
+                "silent_s": round(now - e[2], 3),
+            }
+            for s, e in self._entries.items()
+        }

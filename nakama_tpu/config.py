@@ -450,17 +450,37 @@ class ClusterConfig:
     sockets and forward `MatchmakerAdd`/`Remove` over the bus."""
 
     enabled: bool = False
-    # device_owner: runs the real matchmaker (device pool, interval
-    # loop, journal/checkpoints). frontend: terminates sessions and
-    # forwards matchmaker ops to the device-owner node.
+    # device_owner: runs a real matchmaker (device pool, interval
+    # loop, journal/checkpoints) — one SHARD of the owner fleet.
+    # frontend: terminates sessions and routes matchmaker ops by the
+    # shard map. standby: shadows one owner (standby_of) via journal
+    # replication and promotes on lease expiry.
     role: str = "device_owner"
     # This node's bus listener, `host:port` or `unix:/path`.
     bind: str = "127.0.0.1:7353"
     # Every OTHER node, as `name=host:port` / `name=unix:/path`.
     peers: list[str] = field(default_factory=list)
     # Node name of the device owner; required for frontends (the
-    # fan-in target). Defaults to this node's own name on the owner.
+    # fan-in target) when `shards` is empty. Defaults to this node's
+    # own name on the owner.
     device_owner: str = ""
+    # Owner scale-out (cluster/sharding.py): the owner-fleet node
+    # names — each is one shard id; a ticket's pool/query-family key
+    # rendezvous-hashes over them. Empty = the single-owner map above
+    # (PR 10 behavior, same code path).
+    shards: list[str] = field(default_factory=list)
+    # For role=standby: the owner node (== shard id) this node
+    # shadows. The standby announces itself over heartbeats; the owner
+    # needs no matching knob.
+    standby_of: str = ""
+    # Shard-ownership lease: an owner renews on every heartbeat; a
+    # lease silent past lease_ms is in grace, past lease_ms +
+    # lease_grace_ms it is EXPIRED and the configured standby promotes
+    # (epoch + 1 — frontends re-route within one membership round).
+    # Both must be >= heartbeat_ms or a single delayed heartbeat
+    # could flap ownership.
+    lease_ms: int = 2000
+    lease_grace_ms: int = 3000
     # Peer liveness: heartbeats every heartbeat_ms; a peer silent for
     # down_after_ms is DOWN — its presences are swept from survivors
     # (leave events fired) and, on the owner, its tickets leave the
@@ -533,9 +553,10 @@ class Config:
             )
         cl = self.cluster
         if cl.enabled:
-            if cl.role not in ("device_owner", "frontend"):
+            if cl.role not in ("device_owner", "frontend", "standby"):
                 raise ValueError(
-                    "cluster.role must be device_owner or frontend"
+                    "cluster.role must be device_owner, frontend or"
+                    " standby"
                 )
             peer_names = []
             for spec in cl.peers:
@@ -557,13 +578,75 @@ class Config:
                 raise ValueError(
                     "cluster.peers must not include this node itself"
                 )
+            shards = list(cl.shards)
+            if len(set(shards)) != len(shards):
+                raise ValueError(
+                    "cluster.shards ids must be unique (duplicate"
+                    " shard id)"
+                )
+            for s in shards:
+                if not re.fullmatch(r"[A-Za-z0-9_-]+", s):
+                    raise ValueError(
+                        f"cluster.shards id {s!r} must match"
+                        " [A-Za-z0-9_-]+"
+                    )
+                if s != self.name and s not in peer_names:
+                    raise ValueError(
+                        f"cluster.shards id {s!r} must be this node or"
+                        " a configured peer (shard ids are the owner-"
+                        "fleet node names)"
+                    )
+            if shards and cl.role == "device_owner" and (
+                self.name not in shards
+            ):
+                raise ValueError(
+                    "cluster.role is device_owner but this node is not"
+                    " in cluster.shards"
+                )
+            if cl.standby_of:
+                if cl.standby_of == self.name:
+                    raise ValueError(
+                        "cluster.standby_of must not name this node"
+                        " itself (a standby cannot shadow itself)"
+                    )
+                if shards and cl.standby_of not in shards:
+                    raise ValueError(
+                        "cluster.standby_of must name a shard id from"
+                        " cluster.shards"
+                    )
+                if cl.standby_of not in peer_names:
+                    raise ValueError(
+                        "cluster.standby_of must name a configured"
+                        " peer"
+                    )
+            if cl.role == "standby" and not cl.standby_of:
+                raise ValueError(
+                    "cluster.role is standby but cluster.standby_of"
+                    " is empty"
+                )
+            if cl.lease_grace_ms < cl.heartbeat_ms:
+                raise ValueError(
+                    "cluster.lease_grace_ms must be >="
+                    " cluster.heartbeat_ms (a grace below the"
+                    " heartbeat cadence promotes on one delayed"
+                    " heartbeat)"
+                )
+            if cl.lease_ms < cl.heartbeat_ms:
+                raise ValueError(
+                    "cluster.lease_ms must be >= cluster.heartbeat_ms"
+                )
             owner = cl.device_owner or (
                 self.name if cl.role == "device_owner" else ""
             )
-            if cl.role == "frontend" and owner not in peer_names:
+            if (
+                not shards
+                and cl.role == "frontend"
+                and owner not in peer_names
+            ):
                 raise ValueError(
                     "cluster.device_owner must name a peer when"
-                    " cluster.role is frontend"
+                    " cluster.role is frontend (or configure"
+                    " cluster.shards)"
                 )
             if cl.role == "device_owner" and cl.device_owner not in (
                 "", self.name

@@ -60,6 +60,9 @@ FAULT_POINTS = (
     "cluster.send",      # bus outbound enqueue (cluster/bus.py), per frame
     "cluster.recv",      # bus inbound dispatch (cluster/bus.py), per frame
     "cluster.peer_down", # membership sweep; drop forces a down detection
+    "repl.ship",         # journal tail ship (cluster/replication.py), per batch
+    "repl.apply",        # standby shadow-pool apply, per batch
+    "lease.renew",       # owner lease claim emission (cluster/lease.py)
 )
 
 

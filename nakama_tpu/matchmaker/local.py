@@ -655,6 +655,18 @@ class LocalMatchmaker:
                 raise ErrDuplicateSession(p.session_id)
             session_ids.add(p.session_id)
 
+        if ticket_id is None:
+            ticket_id = str(uuid.uuid4())
+        elif self.store.get(ticket_id) is not None:
+            # Re-delivered cluster forward: the id is already live. The
+            # duplicate check MUST precede the MaxTickets enforcement —
+            # a ticket re-forwarded during an owner takeover (frontend
+            # closing the replication-lag window) is already counted in
+            # this pool's quota, and judging it over-quota here would
+            # reject-back a live ticket instead of absorbing the
+            # idempotent re-delivery.
+            raise KeyError(ticket_id)
+
         max_tickets = self.config.max_tickets
         for p in presences:
             if self.store.session_ticket_count(p.session_id) >= max_tickets:
@@ -664,12 +676,6 @@ class LocalMatchmaker:
             and self.store.party_ticket_count(party_id) >= max_tickets
         ):
             raise ErrTooManyTickets(party_id)
-
-        if ticket_id is None:
-            ticket_id = str(uuid.uuid4())
-        elif self.store.get(ticket_id) is not None:
-            # Re-delivered cluster forward: the id is already live.
-            raise KeyError(ticket_id)
         if created_at is None:
             created_at = time.time()
         string_properties = string_properties or {}

@@ -374,6 +374,39 @@ class Metrics:
             "(leave events fired locally)",
         )
 
+        # Owner scale-out plane (cluster/sharding.py, replication.py,
+        # lease.py): the epoch-versioned shard map (a bump on a shard =
+        # a takeover; the owning node is on the console shard map), the
+        # warm-standby journal replication backlog, per-shard lease
+        # decay, and the takeover counter an operator alerts on.
+        self.cluster_shard_owner = gauge(
+            "cluster_shard_owner",
+            "Current ownership epoch per shard (an epoch bump is a "
+            "lease takeover; the owning node is in the console map)",
+            ("shard",),
+        )
+        self.replication_lag_lsn = gauge(
+            "replication_lag_lsn",
+            "Journal records durable on the owner but not yet "
+            "acknowledged applied by its warm standby",
+        )
+        self.replication_lag_sec = gauge(
+            "replication_lag_sec",
+            "Age of the replication backlog (0 when the standby has "
+            "acknowledged everything durable)",
+        )
+        self.lease_state = gauge(
+            "lease_state",
+            "Per-shard ownership lease state (0 held, 1 in grace, "
+            "2 expired — promotable)",
+            ("shard",),
+        )
+        self.owner_takeovers = counter(
+            "owner_takeovers",
+            "Standby promotions to shard owner, by reason",
+            ("reason",),
+        )
+
         # Message routing / presence events.
         self.outgoing_dropped = counter(
             "socket_outgoing_dropped", "Messages dropped on full session queues"

@@ -177,6 +177,13 @@ class TicketJournal:
         self._resume_at = 0.0
         self._fail_streak = 0
         self.degraded = False
+        # Tail-streaming hook (cluster/replication.py JournalShipper):
+        # called with each durably-drained batch's serialized rows
+        # [(lsn, op, payload_json, node, created_at), ...] AFTER the
+        # group commit resolved — warm-standby replication rides the
+        # flush it already pays for. None (the default) is one
+        # attribute check on the drain path.
+        self.tail_hook = None
         # Ledger totals (tests/console/bench).
         self.appended = 0
         self.flushed = 0
@@ -337,6 +344,15 @@ class TicketJournal:
         del self._buf[: len(batch)]
         self.flushed += len(batch)
         self.durable_lsn = max(self.durable_lsn, batch[-1][0])
+        if self.tail_hook is not None:
+            try:
+                self.tail_hook(rows)
+            except Exception as e:
+                # Replication is best-effort above durability: a dying
+                # shipper costs lag, never the flush that just landed.
+                self.logger.warn(
+                    "journal tail hook failed", error=str(e)
+                )
         self._fail_streak = 0
         if self.degraded:
             self.degraded = False

@@ -443,6 +443,9 @@ class Interp:
             self.burn(4)
             call_env = Env(fn.env)
             for i, p in enumerate(fn.params):
+                if isinstance(p, tuple):  # ("rest", name): the tail
+                    call_env.declare(p[1], JSArray(list(args[i:])))
+                    break
                 call_env.declare(p, args[i] if i < len(args) else UNDEFINED)
             call_env.declare(
                 "arguments", JSArray(list(args))

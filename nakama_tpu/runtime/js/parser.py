@@ -395,16 +395,28 @@ class Parser:
             i += 1
         return False
 
-    def function_tail(self, name):
-        self.expect("op", "(")
+    def param_list(self, closer=")"):
+        """Function parameter list: plain names plus one trailing rest
+        param (`...xs`, TS-compiled var-arg forwarders) encoded as
+        ("rest", name) — the interpreter binds it to an array of the
+        remaining arguments."""
         params = []
-        while not self.at_op(")"):
+        while not self.at_op(closer):
             if self.at_op("..."):
-                self.err("rest params are not supported in this subset")
+                self.next()
+                params.append(("rest", self.expect("name").value))
+                if self.at_op(","):
+                    self.err("rest param must be last")
+                break
             params.append(self.expect("name").value)
             if self.at_op(","):
                 self.next()
-        self.next()
+        self.expect("op", closer)
+        return params
+
+    def function_tail(self, name):
+        self.expect("op", "(")
+        params = self.param_list()
         body = self.block()
         return ("function", name, params, body, False)
 
@@ -451,12 +463,7 @@ class Parser:
             if t.value == "(":
                 if self._arrow_ahead():
                     self.next()
-                    params = []
-                    while not self.at_op(")"):
-                        params.append(self.expect("name").value)
-                        if self.at_op(","):
-                            self.next()
-                    self.next()
+                    params = self.param_list()
                     self.expect("op", "=>")
                     return self.arrow_body(params)
                 self.next()

@@ -141,10 +141,11 @@ class NakamaServer:
         self.tracker.add_listener(
             StreamMode.MATCH_AUTHORITATIVE, self.match_registry.join_listener()
         )
-        if self.cluster is not None and not self.cluster.is_owner:
+        if self.cluster is not None and not self.cluster.runs_pool:
             # Frontend role: no pool, no device, no interval loop —
-            # adds/removes forward to the device-owner node over the
-            # bus behind the same LocalMatchmaker surface.
+            # adds/removes route by the epoch-versioned shard map to
+            # the owning shard's node over the bus, behind the same
+            # LocalMatchmaker surface.
             from .cluster import ClusterMatchmakerClient
 
             self.matchmaker = ClusterMatchmakerClient(
@@ -155,8 +156,12 @@ class NakamaServer:
                 node,
                 self.cluster.owner,
                 metrics=self.metrics,
+                directory=self.cluster.directory,
             )
         else:
+            # Owner shard — or a warm standby, whose LocalMatchmaker is
+            # the replication shadow pool: fully registered (device
+            # rows, duplicate guards) but NOT ticking until promotion.
             self.matchmaker = LocalMatchmaker(
                 log,
                 config.matchmaker,
@@ -164,16 +169,19 @@ class NakamaServer:
                 node,
                 backend=matchmaker_backend,
             )
+        self._cluster_ingest = None
         if self.cluster is not None:
-            if self.cluster.is_owner:
+            if self.cluster.runs_pool:
                 from .cluster import ClusterMatchmakerIngest
 
                 self._cluster_ingest = ClusterMatchmakerIngest(
-                    self.matchmaker, bus, log, self.metrics
+                    self.matchmaker, bus, log, self.metrics,
+                    directory=self.cluster.directory, node=node,
                 )
             self.cluster.wire_sweeps(
                 self.tracker,
-                self.matchmaker if self.cluster.is_owner else None,
+                self.matchmaker if self.cluster.runs_pool else None,
+                ingest=self._cluster_ingest,
             )
         # Group-commit batch size / queue depth / commit counter + the
         # reader-pool high-water mark become scrapeable, and drain spans
@@ -192,7 +200,7 @@ class NakamaServer:
         # stop() drains to durable (journal flush + final checkpoint).
         self.recovery = None
         if config.recovery.enabled and (
-            self.cluster is None or self.cluster.is_owner
+            self.cluster is None or self.cluster.runs_pool
         ):
             from .recovery import RecoveryPlane
 
@@ -203,6 +211,16 @@ class NakamaServer:
                 log,
                 metrics=self.metrics,
                 node=node,
+            )
+        if self.cluster is not None and self.cluster.runs_pool:
+            # Owner scale-out plane: lease claims + journal-tail
+            # shipping on owners, replication apply + failover monitor
+            # on standbys. Needs the matchmaker and (for the shipper)
+            # the recovery journal, hence bound here.
+            self.cluster.wire_matchmaker(
+                self.matchmaker,
+                ingest=self._cluster_ingest,
+                recovery=self.recovery,
             )
         # Overload-control plane (overload.py): built here so the API
         # server and pipeline can reference it; signals are registered
@@ -424,10 +442,11 @@ class NakamaServer:
         self.grpc_port: int | None = None
 
     def _wrap_matched(self, handler):
-        """On the cluster's device-owner node, matched delivery routes
+        """On a pool-hosting cluster node (owner shard or standby —
+        promotion makes the standby publish), matched delivery routes
         back to each ticket's origin node and refuses (→ PR 7
         `unpublished` journal) while a target node is down."""
-        if self.cluster is None or not self.cluster.is_owner:
+        if self.cluster is None or not self.cluster.runs_pool:
             return handler
         from .cluster import cluster_matched_handler
 
@@ -504,6 +523,11 @@ class NakamaServer:
                 replayed_rows=recovered["replayed_rows"],
                 recovery_ms=round(recovered["duration_s"] * 1000, 1),
             )
+        if self.cluster is not None:
+            # Standby failover watchdog AFTER the warm restart: a
+            # replication snapshot must never interleave with the
+            # store restore above.
+            self.cluster.start_failover()
         if self.runtime is None and (
             self._runtime_modules or self.config.runtime.path
         ):
@@ -542,7 +566,18 @@ class NakamaServer:
         self.google_refund_scheduler.runtime = self.runtime
         self.google_refund_scheduler.start()
         self.tracker.start()
-        self.matchmaker.start()
+        if self.cluster is not None and self.cluster.is_standby:
+            # Warm standby: the shadow pool applies the owner's journal
+            # stream but must NOT tick — the failover monitor starts
+            # the interval/delivery loops at promotion.
+            self.logger.info(
+                "standby shadow pool armed (not ticking)",
+                standby_of=self.config.cluster.standby_of,
+                lease_ms=self.config.cluster.lease_ms,
+                lease_grace_ms=self.config.cluster.lease_grace_ms,
+            )
+        else:
+            self.matchmaker.start()
         if self.overload is not None:
             # Ladder signals read components that now exist: storage
             # write-queue depth (PR 2's gauge, read directly), the

@@ -1,0 +1,683 @@
+"""Owner scale-out units: rendezvous sharding + the epoch-versioned
+directory, shard-routed client forwarding with takeover re-forward,
+epoch-aware ingest sweeps, warm-standby journal replication (ship /
+apply / sync / lag), the lease protocol (renew / demote / promote
+exactly once), and the `owner_failover_regression` bench gate.
+
+All in-process, like test_cluster.py: port-0 buses on loopback; the
+subprocess SIGKILL story lives in test_cluster_failover_smoke.py and
+`bench.py --failover`; chaos legs for repl.ship / repl.apply /
+lease.renew live in test_faults_chaos.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from fixtures import quiet_logger
+
+from nakama_tpu import faults
+from nakama_tpu.cluster import (
+    ClusterBus,
+    ClusterMatchmakerClient,
+    ClusterMatchmakerIngest,
+    FailoverMonitor,
+    JournalShipper,
+    LeaseManager,
+    Membership,
+    ReplicationApplier,
+    ShardDirectory,
+    rendezvous_shard,
+    shard_key,
+)
+from nakama_tpu.cluster.sharding import (
+    LEASE_EXPIRED,
+    LEASE_GRACE,
+    LEASE_HELD,
+)
+from nakama_tpu.config import MatchmakerConfig
+from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+from nakama_tpu.matchmaker.local import ErrTooManyTickets
+
+LOG = quiet_logger()
+
+
+# ------------------------------------------------------------- sharding
+
+
+def test_shard_key_pool_property_wins_over_query():
+    assert shard_key("+properties.mode:x", {"pool": "arena"}) == "arena"
+    assert shard_key("+properties.mode:x", {}) == "+properties.mode:x"
+    assert shard_key("", None) == "*"
+
+
+def test_rendezvous_deterministic_and_minimal_movement():
+    shards = ["o1", "o2", "o3"]
+    keys = [f"pool-{i}" for i in range(300)]
+    first = {k: rendezvous_shard(k, shards) for k in keys}
+    # Deterministic across calls and shard-list order.
+    assert first == {
+        k: rendezvous_shard(k, list(reversed(shards))) for k in keys
+    }
+    # Every shard gets a share of the keyspace.
+    assert {first[k] for k in keys} == set(shards)
+    # Removing o3 moves ONLY o3's keys (rendezvous minimal movement).
+    two = ["o1", "o2"]
+    for k in keys:
+        if first[k] != "o3":
+            assert rendezvous_shard(k, two) == first[k]
+
+
+def test_directory_claims_renewals_takeovers_and_lease_decay():
+    clock = [100.0]
+    d = ShardDirectory(
+        "f", ["o1", "o2"], lease_ms=1000, lease_grace_ms=2000,
+        clock=lambda: clock[0],
+    )
+    moves = []
+    d.on_transition.append(lambda *a: moves.append(a))
+    # Seeded: shard ids own themselves at epoch 0.
+    assert d.route(shard_key("*", {"pool": "p"}))[1] in ("o1", "o2")
+    shard = d.shard_for_key("p")
+    # Renewal: same node, same epoch — refreshes the lease clock.
+    clock[0] += 0.9
+    assert d.claim(shard, shard, 0)
+    assert d.lease_state(shard) == LEASE_HELD
+    # Decay: held -> grace -> expired as the clock runs.
+    clock[0] += 1.5
+    assert d.lease_state(shard) == LEASE_GRACE
+    clock[0] += 2.0
+    assert d.lease_state(shard) == LEASE_EXPIRED
+    # Takeover: higher epoch replaces the owner and fires transitions.
+    assert d.claim(shard, "sb", 1)
+    assert d.owner_of(shard) == ("sb", 1)
+    assert moves == [(shard, shard, "sb", 1)]
+    assert d.takeovers == 1
+    # Stale-epoch renewal from the demoted owner is refused everywhere.
+    assert not d.claim(shard, shard, 0)
+    assert d.owner_of(shard) == ("sb", 1)
+    # Equal-epoch claim from a DIFFERENT node is refused (no silent
+    # dueling owners), while the current owner's renewal is accepted.
+    assert not d.claim(shard, "evil", 1)
+    assert d.claim(shard, "sb", 1)
+    assert d.max_epoch() == 1
+    assert "sb" in d.owners()
+
+
+def test_dup_readd_recognized_before_max_tickets():
+    """The takeover seam's bugfix: a re-forwarded ticket (same id) must
+    be absorbed as a duplicate, NOT rejected over MaxTickets — the old
+    ordering judged the already-pooled ticket against its own quota."""
+    mm = LocalMatchmaker(
+        LOG,
+        MatchmakerConfig(backend="cpu", pool_capacity=16, max_tickets=1),
+        node="o",
+    )
+    p = [MatchmakerPresence("u1", "s1", node="f")]
+    mm.add(p, "s1", "", "*", 2, 2, ticket_id="t1.f")
+    # Same id again: KeyError (idempotent re-delivery), never quota.
+    with pytest.raises(KeyError):
+        mm.add(p, "s1", "", "*", 2, 2, ticket_id="t1.f")
+    # A genuinely NEW ticket for the session still hits the quota.
+    with pytest.raises(ErrTooManyTickets):
+        mm.add(p, "s1", "", "*", 2, 2, ticket_id="t2.f")
+
+
+# ------------------------------------------------------ two-owner rig
+
+
+async def _mk_bus(node):
+    bus = ClusterBus(node, "127.0.0.1:0", {}, LOG)
+    await bus.start()
+    return bus
+
+
+async def _link(*buses):
+    for a in buses:
+        for b in buses:
+            if a is not b:
+                a.add_peer(b.node, f"127.0.0.1:{b.port}")
+
+
+async def _drain(seconds=0.3):
+    await asyncio.sleep(seconds)
+
+
+def _mm_cfg(max_tickets=8):
+    return MatchmakerConfig(
+        backend="cpu", pool_capacity=64, max_tickets=max_tickets
+    )
+
+
+async def _mk_sharded_rig():
+    """Two owner shards (o1, o2) + one frontend (f), full mesh, every
+    node with its own directory over the same static shard ids."""
+    shards = ["o1", "o2"]
+    buses = {n: await _mk_bus(n) for n in ("o1", "o2", "f")}
+    await _link(*buses.values())
+    members = {
+        n: Membership(b, LOG, heartbeat_ms=50, down_after_ms=10_000)
+        for n, b in buses.items()
+    }
+    dirs = {
+        n: ShardDirectory(n, shards, lease_ms=500, lease_grace_ms=500)
+        for n in buses
+    }
+    mms, ingests = {}, {}
+    for n in ("o1", "o2"):
+        mms[n] = LocalMatchmaker(LOG, _mm_cfg(), node=n)
+        ingests[n] = ClusterMatchmakerIngest(
+            mms[n], buses[n], LOG, directory=dirs[n], node=n
+        )
+    client = ClusterMatchmakerClient(
+        LOG, _mm_cfg(), buses["f"], members["f"], "f",
+        directory=dirs["f"],
+    )
+    for m in members.values():
+        m.start()
+    for _ in range(60):
+        await asyncio.sleep(0.05)
+        if all(
+            members["f"].is_up(o) for o in ("o1", "o2")
+        ) and members["o1"].is_up("f"):
+            break
+    assert members["f"].is_up("o1") and members["f"].is_up("o2")
+    return {
+        "buses": buses, "members": members, "dirs": dirs,
+        "mms": mms, "ingests": ingests, "client": client,
+        "shards": shards,
+    }
+
+
+async def _rig_down(rig):
+    for m in rig["members"].values():
+        m.stop()
+    for b in rig["buses"].values():
+        await b.stop()
+
+
+def _pools_for_both_shards(shards):
+    """Pool names that rendezvous onto each shard (deterministic)."""
+    by_shard = {}
+    i = 0
+    while len(by_shard) < len(shards):
+        pool = f"pool-{i}"
+        s = rendezvous_shard(pool, shards)
+        by_shard.setdefault(s, pool)
+        i += 1
+    return by_shard
+
+
+async def test_client_routes_by_pool_key_across_shards():
+    rig = await _mk_sharded_rig()
+    client, mms = rig["client"], rig["mms"]
+    by_shard = _pools_for_both_shards(rig["shards"])
+    tids = {}
+    for shard, pool in by_shard.items():
+        tid, _ = client.add(
+            [MatchmakerPresence(f"u-{pool}", f"s-{pool}", node="f")],
+            f"s-{pool}", "", "*", 2, 2,
+            string_properties={"pool": pool},
+        )
+        tids[shard] = tid
+    await _drain()
+    # Each ticket landed on ITS shard's pool — and only there.
+    for shard, tid in tids.items():
+        assert mms[shard].store.get(tid) is not None, shard
+        other = "o2" if shard == "o1" else "o1"
+        assert mms[other].store.get(tid) is None
+    assert len(client) == len(by_shard)
+    await _rig_down(rig)
+
+
+async def test_takeover_reforwards_pending_tickets_idempotently():
+    rig = await _mk_sharded_rig()
+    client, mms, dirs = rig["client"], rig["mms"], rig["dirs"]
+    by_shard = _pools_for_both_shards(rig["shards"])
+    pool = by_shard["o1"]
+    tid, _ = client.add(
+        [MatchmakerPresence("u1", "s1", node="f")],
+        "s1", "", "+properties.never:x", 2, 2,
+        string_properties={"pool": pool},
+    )
+    await _drain()
+    assert mms["o1"].store.get(tid) is not None
+    at_before = client._meta[tid][2]
+    await asyncio.sleep(0.05)
+    # o2 takes over shard o1 at epoch 1 (the promoted-standby shape —
+    # here the "standby" is o2, which also runs a pool). Fold the claim
+    # at o2 FIRST (the promoter always knows before the frontends).
+    dirs["o2"].claim("o1", "o2", 1)
+    dirs["f"].claim("o1", "o2", 1)
+    await _drain()
+    # The client re-forwarded the pending ticket to the new owner
+    # under its ORIGINAL id, and refreshed the TTL clock (epoch-aware
+    # liveness valve: the takeover must not age the entry out).
+    assert mms["o2"].store.get(tid) is not None
+    assert client._meta[tid][2] > at_before
+    assert len(client) == 1
+    # New adds for that pool route straight to the new owner.
+    tid2, _ = client.add(
+        [MatchmakerPresence("u2", "s2", node="f")],
+        "s2", "", "+properties.never:y", 2, 2,
+        string_properties={"pool": pool},
+    )
+    await _drain()
+    assert mms["o2"].store.get(tid2) is not None
+    assert mms["o1"].store.get(tid2) is None
+    await _rig_down(rig)
+
+
+async def test_ingest_rejects_not_owner_and_client_reroutes():
+    rig = await _mk_sharded_rig()
+    client, mms, dirs = rig["client"], rig["mms"], rig["dirs"]
+    by_shard = _pools_for_both_shards(rig["shards"])
+    pool = by_shard["o1"]
+    # o1 already knows it lost the shard; the frontend's map is stale,
+    # so its add goes to o1 — which must bounce it back (not_owner),
+    # NOT swallow it or register it.
+    dirs["o1"].claim("o1", "o2", 1)
+    tid, _ = client.add(
+        [MatchmakerPresence("u1", "s1", node="f")],
+        "s1", "", "+properties.never:x", 2, 2,
+        string_properties={"pool": pool},
+    )
+    await _drain()
+    assert mms["o1"].store.get(tid) is None
+    # The reject carried not_owner; once the frontend's map catches up
+    # (one membership round in production), the re-route lands on o2.
+    dirs["f"].claim("o1", "o2", 1)
+    dirs["o2"].claim("o1", "o2", 1)
+    client._on_reject("o1", {"ticket": tid, "reason": "not_owner"})
+    await _drain()
+    assert mms["o2"].store.get(tid) is not None
+    assert len(client) == 1  # bookkeeping retained throughout
+    await _rig_down(rig)
+
+
+async def test_epoch_aware_sweep_spares_reaadded_tickets():
+    """The satellite regression (forced epoch bump): a ticket re-added
+    to the new owner during a takeover must not be swept by a peer-
+    death observation made at the OLD epoch."""
+    rig = await _mk_sharded_rig()
+    mms, dirs, ingests = rig["mms"], rig["dirs"], rig["ingests"]
+    by_shard = _pools_for_both_shards(rig["shards"])
+    pool = by_shard["o1"]  # lives on shard o1, which o2 will take over
+    client = rig["client"]
+    tid, _ = client.add(
+        [MatchmakerPresence("u1", "s1", node="f")],
+        "s1", "", "+properties.never:x", 2, 2,
+        string_properties={"pool": pool},
+    )
+    await _drain()
+    assert mms["o1"].store.get(tid) is not None
+    epoch_at_death = dirs["o2"].max_epoch()  # the stale observation
+    # Takeover bumps the epoch; the frontend re-forwards the ticket
+    # (same id) — the dup guard absorbs it and REFRESHES its stamp.
+    dirs["o2"].claim("o1", "o2", 1)
+    dirs["f"].claim("o1", "o2", 1)
+    await _drain()
+    assert ingests["o2"]._add_epoch[tid] == 1
+    # The old-epoch sweep must spare it ...
+    assert ingests["o2"].sweep_node("f", epoch=epoch_at_death) == 0
+    assert mms["o2"].store.get(tid) is not None
+    # ... while a current-epoch sweep (f really is dead now) takes it.
+    assert ingests["o2"].sweep_node("f", epoch=1) == 1
+    assert mms["o2"].store.get(tid) is None
+    await _rig_down(rig)
+
+
+async def test_cancelled_ticket_does_not_resurrect_on_takeover():
+    """The remove-side closure of the replication-lag window: a
+    removal whose journal row never shipped must not let the cancelled
+    ticket resurrect out of the promoted owner's replicated shadow
+    pool — the frontend re-sends its removal tombstones on the shard
+    transition."""
+    rig = await _mk_sharded_rig()
+    client, mms, dirs = rig["client"], rig["mms"], rig["dirs"]
+    by_shard = _pools_for_both_shards(rig["shards"])
+    pool = by_shard["o1"]
+    tid, _ = client.add(
+        [MatchmakerPresence("u1", "s1", node="f")],
+        "s1", "", "+properties.never:x", 2, 2,
+        string_properties={"pool": pool},
+    )
+    await _drain()
+    assert mms["o1"].store.get(tid) is not None
+    # Simulate the replicated shadow: o2 (the taker-over) already
+    # holds the ticket from the journal stream.
+    from nakama_tpu.cluster.replication import extract_to_payload
+    from nakama_tpu.recovery import payload_to_extract
+
+    ex = [e for e in mms["o1"].extract() if e.ticket == tid]
+    mms["o2"].insert([payload_to_extract(extract_to_payload(ex[0]))])
+    assert mms["o2"].store.get(tid) is not None
+    # The client cancels; the remove's journal row "never ships"
+    # (we simply never replicate it to o2).
+    client.remove_session("s1", tid)
+    await _drain()
+    assert mms["o1"].store.get(tid) is None
+    assert mms["o2"].store.get(tid) is not None  # the lag window
+    # Takeover: the tombstone re-forwards and the ticket dies with it.
+    dirs["o2"].claim("o1", "o2", 1)
+    dirs["f"].claim("o1", "o2", 1)
+    await _drain()
+    assert mms["o2"].store.get(tid) is None
+    await _rig_down(rig)
+
+
+def test_owner_for_ticket_without_bookkeeping_broadcasts():
+    """A removal for a ticket whose bookkeeping is gone (TTL expiry
+    race) must broadcast to every owner — guessing one would silently
+    drop it on a multi-shard fleet."""
+    d = ShardDirectory("f", ["o1", "o2"])
+    client = ClusterMatchmakerClient.__new__(ClusterMatchmakerClient)
+    client._meta = {}
+    client.directory = d
+    assert client._owner_for_ticket("ghost.f") == ""
+
+
+# ----------------------------------------------------------- replication
+
+
+async def _mk_repl_rig(tmp_path, flush_max=2048):
+    from nakama_tpu.recovery import TicketJournal
+    from nakama_tpu.storage.db import Database
+
+    bus_o = await _mk_bus("o1")
+    bus_s = await _mk_bus("sb")
+    await _link(bus_o, bus_s)
+    db = Database(str(tmp_path / "owner.db"), read_pool_size=1)
+    await db.connect()
+    mm = LocalMatchmaker(LOG, _mm_cfg(), node="o1")
+    journal = TicketJournal(db, LOG, node="o1", flush_max=flush_max)
+    mm.journal = journal
+    shipper = JournalShipper(journal, mm, bus_o, "o1", LOG)
+    shadow = LocalMatchmaker(LOG, _mm_cfg(), node="sb")
+    applier = ReplicationApplier(shadow, bus_s, "o1", "sb", LOG)
+    shipper.set_standby("sb")
+    return {
+        "buses": (bus_o, bus_s), "db": db, "mm": mm,
+        "journal": journal, "shipper": shipper,
+        "shadow": shadow, "applier": applier,
+    }
+
+
+async def _repl_down(rig):
+    for b in rig["buses"]:
+        await b.stop()
+    await rig["db"].close()
+
+
+def _never_ticket(mm, i, node="f"):
+    return mm.add(
+        [MatchmakerPresence(f"u{i}", f"s{i}", node=node)],
+        f"s{i}", "", f"+properties.never:z{i}", 2, 2,
+    )
+
+
+async def test_journal_tail_ships_to_shadow_pool_with_lsn_parity(
+    tmp_path,
+):
+    rig = await _mk_repl_rig(tmp_path)
+    mm, journal = rig["mm"], rig["journal"]
+    shipper, applier, shadow = (
+        rig["shipper"], rig["applier"], rig["shadow"],
+    )
+    tids = [_never_ticket(mm, i)[0] for i in range(5)]
+    assert await journal.flush()
+    await _drain()
+    # The flush's tail hook shipped; the shadow pool holds the tickets
+    # and the ack brought the owner's lag to zero.
+    assert len(shadow) == 5
+    for tid in tids:
+        assert shadow.store.get(tid) is not None
+    assert applier.applied_lsn == journal.lsn
+    assert shipper.acked_lsn == journal.lsn
+    assert shipper.lag_lsn() == 0 and shipper.lag_sec() == 0.0
+    # Removals stream too; re-shipped batches are idempotent.
+    mm.remove([tids[0]])
+    assert await journal.flush()
+    await _drain()
+    assert shadow.store.get(tids[0]) is None and len(shadow) == 4
+    before = applier.applied
+    applier._on_ship(
+        "o1",
+        {"records": [[1, "add", "{}"]], "t": 0.0},  # stale LSN
+    )
+    assert applier.applied == before  # skipped by the watermark
+    assert applier.skipped >= 1
+    await _repl_down(rig)
+
+
+async def test_ship_drop_grows_lag_then_sync_heals_to_parity(tmp_path):
+    rig = await _mk_repl_rig(tmp_path)
+    mm, journal = rig["mm"], rig["journal"]
+    shipper, applier, shadow = (
+        rig["shipper"], rig["applier"], rig["shadow"],
+    )
+    # Seed one replicated ticket so the stream is established.
+    _never_ticket(mm, 0)
+    assert await journal.flush()
+    await _drain()
+    assert len(shadow) == 1
+    # Every ship dropped: lag grows while the journal stays durable.
+    faults.arm("repl.ship", "drop", probability=1.0)
+    for i in range(1, 6):
+        _never_ticket(mm, i)
+    assert await journal.flush()
+    await _drain(0.2)
+    assert len(shadow) == 1  # nothing arrived
+    assert shipper.lag_lsn() == 5
+    assert shipper.dropped >= 5
+    faults.disarm("repl.ship")
+    # Catch-up: the applier requests a snapshot and heals to parity.
+    applier.need_sync = True
+    applier.tick()
+    await _drain()
+    assert len(shadow) == len(mm) == 6
+    assert applier.applied_lsn == journal.lsn
+    assert shipper.lag_lsn() == 0
+    await _repl_down(rig)
+
+
+async def test_apply_fault_degrades_standby_never_the_owner(tmp_path):
+    rig = await _mk_repl_rig(tmp_path)
+    mm, journal = rig["mm"], rig["journal"]
+    applier, shadow = rig["applier"], rig["shadow"]
+    faults.arm("repl.apply", "raise", probability=1.0)
+    _never_ticket(mm, 0)
+    assert await journal.flush()  # the owner's flush is untouched
+    await _drain()
+    assert len(shadow) == 0
+    assert applier.apply_failures >= 1 and applier.need_sync
+    # The owner keeps matching — its interval loop never sees the
+    # standby's failure.
+    mm.process()
+    faults.disarm("repl.apply")
+    applier._last_sync_req = 0.0
+    applier.tick()
+    await _drain()
+    assert len(shadow) == len(mm)
+    assert applier.applied_lsn == journal.lsn
+    await _repl_down(rig)
+
+
+async def test_unpublished_records_repool_on_the_standby(tmp_path):
+    rig = await _mk_repl_rig(tmp_path)
+    mm, journal, shadow = rig["mm"], rig["journal"], rig["shadow"]
+    t1, _ = _never_ticket(mm, 1)
+    t2, _ = _never_ticket(mm, 2)
+    objs = [mm.store.get(t1), mm.store.get(t2)]
+    mm.remove([t1, t2])  # journals the removes
+    # A formed-but-unpublished cohort: full payloads in the journal —
+    # the standby re-pools them exactly like recover() would.
+    journal.record_unpublished(lambda: objs)
+    assert await journal.flush()
+    await _drain()
+    assert shadow.store.get(t1) is not None
+    assert shadow.store.get(t2) is not None
+    await _repl_down(rig)
+
+
+# ----------------------------------------------------------------- lease
+
+
+def test_lease_manager_renews_and_stands_down_on_higher_epoch():
+    d = ShardDirectory("o1", ["o1", "o2"], lease_ms=500,
+                       lease_grace_ms=500)
+    lease = LeaseManager(d, "o1", ["o1"], LOG)
+    demoted = []
+    lease.on_demoted = lambda *a: demoted.append(a)
+    body = lease.heartbeat_payload()
+    assert body["claims"] == [
+        {"shard": "o1", "node": "o1", "epoch": 1}
+    ]
+    # A promoted standby claims at a higher epoch: the manager stands
+    # down — no more claims for that shard, demotion hook fired.
+    d.claim("o1", "sb", 2)
+    assert demoted == [("o1", "sb", 2)]
+    assert lease.owned == set()
+    assert "claims" not in lease.heartbeat_payload()
+    # Its stale renewal would be refused anyway.
+    assert not d.claim("o1", "o1", 1)
+
+
+def test_lease_renew_fault_silences_claims():
+    d = ShardDirectory("o1", ["o1"], lease_ms=500, lease_grace_ms=500)
+    lease = LeaseManager(d, "o1", ["o1"], LOG)
+    with faults.armed_ctx("lease.renew", mode="drop"):
+        assert "claims" not in lease.heartbeat_payload()
+    assert lease.heartbeat_payload()["claims"]
+
+
+async def test_failover_monitor_promotes_exactly_once():
+    clock = [0.0]
+    d = ShardDirectory(
+        "sb", ["o1"], lease_ms=1000, lease_grace_ms=1000,
+        clock=lambda: clock[0],
+    )
+    lease = LeaseManager(d, "sb", [], LOG)
+    mm = LocalMatchmaker(LOG, _mm_cfg(), node="sb")
+    monitor = FailoverMonitor(
+        d, lease, "o1", "sb", LOG, matchmaker=mm,
+    )
+    # Cold boot: the seed entry (epoch 0) is not evidence about the
+    # owner — even a decayed seed lease never promotes (the boot-race
+    # fence: promotion requires one OBSERVED renewal).
+    assert not monitor.check(now=99.0)
+    clock[0] = 99.0
+    assert d.claim("o1", "o1", 1)  # the owner's first heard renewal
+    # Held lease: no promotion.
+    assert not monitor.check(now=99.5)
+    # Grace: still no promotion.
+    assert not monitor.check(now=100.5)
+    # Expired past grace: promote — exactly once.
+    assert monitor.check(now=101.5)
+    await monitor.promote("lease_expired")
+    assert monitor.promoted
+    assert d.owner_of("o1") == ("sb", 2)
+    assert "o1" in lease.owned  # the standby now renews the lease
+    assert mm._task is not None  # interval loop started
+    assert not monitor.check(now=999.0)  # never a second takeover
+    await monitor.promote("lease_expired")
+    assert monitor.promotions == 1
+    mm.stop()
+
+
+def test_restarted_owner_stands_down_instead_of_dueling():
+    """The restart-through-takeover fence: an owner that crashed, was
+    superseded at epoch 2, and restarts with a fresh directory (seed
+    epoch 0) must NOT mint an equal-epoch claim — it listens for a few
+    rounds, folds the promoted claim, and its own refused claim turns
+    into a demotion. No duel, no split map."""
+    d = ShardDirectory("o1", ["o1"], lease_ms=500, lease_grace_ms=500)
+    lease = LeaseManager(d, "o1", ["o1"], LOG, boot_grace_rounds=2)
+    demoted = []
+    lease.on_demoted = lambda *a: demoted.append(a)
+    # Listen window: no claims emitted.
+    assert "claims" not in lease.heartbeat_payload()
+    # The promoted standby's claim arrives mid-window.
+    assert d.claim("o1", "sb", 2)
+    assert "claims" not in lease.heartbeat_payload()
+    # Window over: the self-claim (epoch max(1, 2)=2, node o1) is
+    # refused by the equal-epoch rule → demotion by refusal.
+    body = lease.heartbeat_payload()
+    assert "claims" not in body
+    assert lease.owned == set()
+    assert demoted == [("o1", "sb", 2)]
+    assert d.owner_of("o1") == ("sb", 2)  # the map never flapped
+
+
+def test_applier_late_attach_requests_snapshot_not_partial_stream(
+    tmp_path,
+):
+    """A standby that attaches after the owner already journaled a
+    prefix must NOT treat the first mid-stream ship as its baseline —
+    it re-syncs, else the shadow pool silently misses the prefix."""
+    from nakama_tpu.cluster import ReplicationApplier
+
+    class _Bus:
+        def __init__(self):
+            self.sent = []
+
+        def on(self, *a):
+            pass
+
+        def send(self, peer, t, d):
+            self.sent.append((peer, t, d))
+            return True
+
+    bus = _Bus()
+    shadow = LocalMatchmaker(LOG, _mm_cfg(), node="sb")
+    applier = ReplicationApplier(shadow, bus, "o1", "sb", LOG)
+    # Mid-stream batch (LSNs 1001+) while applied_lsn is 0: refused.
+    applier._on_ship(
+        "o1",
+        {"records": [[1001, "remove", '{"tickets": []}']], "t": 0.0},
+    )
+    assert applier.applied == 0
+    assert not applier.synced and applier.need_sync
+    applier.tick()
+    assert any(t == "repl.sync" for _, t, _d in bus.sent)
+
+
+# ------------------------------------------------------- the bench gate
+
+
+def test_owner_failover_regression_gate_units():
+    import bench
+
+    ok = dict(
+        single_p99_ms=1000.0,
+        two_shard_p99_ms=1100.0,
+        lost_tickets=0,
+        availability_gap_ms=2500.0,
+        lease_grace_ms=2000,
+        repl_lag_p99_s=0.2,
+        checkpoint_interval_s=10.0,
+        ship_overhead_pct=0.01,
+        healed=True,
+        hung=0,
+        both_shards_used=True,
+        restarted=False,
+    )
+    reasons, reg = bench.owner_failover_regression(**ok)
+    assert not reg and not reasons
+    for patch, needle in (
+        (dict(lost_tickets=2), "lost_tickets"),
+        (dict(two_shard_p99_ms=1300.0), "p99"),
+        (dict(availability_gap_ms=4100.0), "availability"),
+        (dict(repl_lag_p99_s=11.0), "replication"),
+        (dict(ship_overhead_pct=1.5), "overhead"),
+        (dict(healed=False), "heal"),
+        (dict(hung=1), "hung"),
+        (dict(both_shards_used=False), "shard"),
+        (dict(restarted=True), "restart"),
+    ):
+        reasons, reg = bench.owner_failover_regression(
+            **{**ok, **patch}
+        )
+        assert reg and any(needle in r for r in reasons), (patch, reasons)

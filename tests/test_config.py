@@ -115,6 +115,70 @@ def test_cluster_config_check():
         cfg.check()
 
 
+def test_cluster_shard_config_check():
+    """Owner scale-out knobs: duplicate shard ids, a standby naming
+    itself, and a lease grace below the heartbeat cadence must all be
+    rejected loudly (the satellite's exact list)."""
+
+    def base():
+        cfg = Config()
+        cfg.name = "f1"
+        cfg.cluster.enabled = True
+        cfg.cluster.role = "frontend"
+        cfg.cluster.peers = [
+            "o1=127.0.0.1:7353",
+            "o2=127.0.0.1:7354",
+            "sb=127.0.0.1:7355",
+        ]
+        cfg.cluster.shards = ["o1", "o2"]
+        return cfg
+
+    base().check()  # the sharded-frontend shape needs no device_owner
+    cfg = base()
+    cfg.cluster.shards = ["o1", "o1"]
+    with pytest.raises(ValueError, match="duplicate shard"):
+        cfg.check()
+    cfg = base()
+    cfg.cluster.shards = ["o1", "ghost"]
+    with pytest.raises(ValueError, match="peer"):
+        cfg.check()  # shard ids are the owner-fleet node names
+    cfg = base()
+    cfg.cluster.shards = ["o1", "bad.name"]
+    with pytest.raises(ValueError, match="A-Za-z0-9"):
+        cfg.check()
+    # A standby must shadow a shard — never itself.
+    cfg = base()
+    cfg.name = "sb"
+    cfg.cluster.role = "standby"
+    cfg.cluster.peers = ["o1=127.0.0.1:7353", "o2=127.0.0.1:7354"]
+    with pytest.raises(ValueError, match="standby_of"):
+        cfg.check()  # standby role requires standby_of
+    cfg.cluster.standby_of = "sb"
+    with pytest.raises(ValueError, match="itself"):
+        cfg.check()
+    cfg.cluster.standby_of = "o3"
+    with pytest.raises(ValueError, match="shard"):
+        cfg.check()  # must name a shard id
+    cfg.cluster.standby_of = "o1"
+    cfg.check()
+    # Lease knobs below the heartbeat cadence flap ownership.
+    cfg = base()
+    cfg.cluster.lease_grace_ms = cfg.cluster.heartbeat_ms - 1
+    with pytest.raises(ValueError, match="lease_grace_ms"):
+        cfg.check()
+    cfg = base()
+    cfg.cluster.lease_ms = cfg.cluster.heartbeat_ms - 1
+    with pytest.raises(ValueError, match="lease_ms"):
+        cfg.check()
+    # Owner role must be part of the fleet it claims to own.
+    cfg = base()
+    cfg.name = "o3"
+    cfg.cluster.role = "device_owner"
+    cfg.cluster.peers = ["o1=127.0.0.1:7353", "o2=127.0.0.1:7354"]
+    with pytest.raises(ValueError, match="shards"):
+        cfg.check()
+
+
 def test_parse_args_config_flag(tmp_path):
     p = tmp_path / "c.yml"
     p.write_text("name: n1\n")

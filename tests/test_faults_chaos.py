@@ -1221,3 +1221,209 @@ async def test_cluster_peer_down_fault_warns_ladder_and_sweeps():
     finally:
         faults.disarm()
         await _cluster_rig_down(rig)
+
+
+# ------------------------------------------- owner scale-out fault points
+
+
+async def _repl_rig(tmp_path_dir):
+    """Owner journal + shipper and a standby shadow pool + applier on
+    two loopback buses — the smallest rig repl.ship / repl.apply fire
+    on. The owner's interval loop (mm.process) runs throughout: the
+    degradation contract is standby-side only."""
+    import os
+
+    from nakama_tpu.cluster import (
+        ClusterBus,
+        JournalShipper,
+        ReplicationApplier,
+    )
+    from nakama_tpu.recovery import TicketJournal
+
+    log = quiet_logger()
+    cfg = MatchmakerConfig(backend="cpu", pool_capacity=64,
+                           max_tickets=64)
+    bus_o = ClusterBus("o1", "127.0.0.1:0", {}, log)
+    bus_s = ClusterBus("sb", "127.0.0.1:0", {}, log)
+    await bus_o.start()
+    await bus_s.start()
+    bus_o.add_peer("sb", f"127.0.0.1:{bus_s.port}")
+    bus_s.add_peer("o1", f"127.0.0.1:{bus_o.port}")
+    db = Database(
+        os.path.join(tmp_path_dir, "repl-owner.db"), read_pool_size=1
+    )
+    await db.connect()
+    mm = LocalMatchmaker(log, cfg, node="o1")
+    journal = TicketJournal(db, log, node="o1")
+    mm.journal = journal
+    shipper = JournalShipper(journal, mm, bus_o, "o1", log)
+    shadow = LocalMatchmaker(log, cfg, node="sb")
+    applier = ReplicationApplier(shadow, bus_s, "o1", "sb", log)
+    shipper.set_standby("sb")
+    return {
+        "buses": (bus_o, bus_s), "db": db, "mm": mm,
+        "journal": journal, "shipper": shipper, "shadow": shadow,
+        "applier": applier,
+    }
+
+
+async def _repl_rig_down(rig):
+    for b in rig["buses"]:
+        await b.stop()
+    await rig["db"].close()
+
+
+async def test_repl_ship_drop_lag_grows_then_heals_to_lsn_parity():
+    with tempfile.TemporaryDirectory() as d:
+        rig = await _repl_rig(d)
+        mm, journal = rig["mm"], rig["journal"]
+        shipper, applier, shadow = (
+            rig["shipper"], rig["applier"], rig["shadow"],
+        )
+        try:
+            # Establish the stream, then drop ships at p=0.7 seeded:
+            # lag GROWS while the owner's journal/interval loop run
+            # untouched — replication is best-effort above durability.
+            mm.add([MatchmakerPresence("u0", "s0", node="f")],
+                   "s0", "", "+properties.x:never", 2, 2)
+            assert await journal.flush()
+            await asyncio.sleep(0.3)
+            assert len(shadow) == 1
+            faults.arm("repl.ship", "drop", probability=0.7, seed=13)
+            for i in range(1, 13):
+                mm.add(
+                    [MatchmakerPresence(f"u{i}", f"s{i}", node="f")],
+                    f"s{i}", "", "+properties.x:never", 2, 2,
+                )
+                assert await journal.flush()
+                mm.process()  # the interval loop never wedges
+            await asyncio.sleep(0.3)
+            assert faults.PLANE.fired.get("repl.ship", 0) > 0
+            assert shipper.dropped > 0
+            assert shipper.lag_lsn() > 0  # lag really grew
+            assert journal.durable_lsn == journal.lsn  # owner durable
+            faults.disarm("repl.ship")
+            # Heal: the applier detects the hole and snapshots back to
+            # exact LSN parity + pool parity.
+            applier.need_sync = True
+            applier._last_sync_req = 0.0
+            applier.tick()
+            await asyncio.sleep(0.4)
+            assert applier.applied_lsn == journal.lsn
+            assert shipper.lag_lsn() == 0
+            assert len(shadow) == len(mm)
+        finally:
+            faults.disarm()
+            await _repl_rig_down(rig)
+
+
+async def test_repl_apply_raise_degrades_standby_not_owner_loop():
+    with tempfile.TemporaryDirectory() as d:
+        rig = await _repl_rig(d)
+        mm, journal = rig["mm"], rig["journal"]
+        applier, shadow = rig["applier"], rig["shadow"]
+        try:
+            faults.arm("repl.apply", "raise", probability=1.0)
+            for i in range(6):
+                mm.add(
+                    [MatchmakerPresence(f"a{i}", f"as{i}", node="f")],
+                    f"as{i}", "", "+properties.x:never", 2, 2,
+                )
+                assert await journal.flush()  # owner flush untouched
+                mm.process()  # owner interval loop never wedges
+            await asyncio.sleep(0.3)
+            assert faults.PLANE.fired.get("repl.apply", 0) > 0
+            assert len(shadow) == 0  # standby degraded, batches lost
+            assert applier.need_sync and applier.apply_failures > 0
+            assert len(mm) == 6  # the owner never noticed
+            faults.disarm("repl.apply")
+            applier._last_sync_req = 0.0
+            applier.tick()
+            await asyncio.sleep(0.4)
+            assert len(shadow) == 6  # healed to parity via snapshot
+            assert applier.applied_lsn == journal.lsn
+        finally:
+            faults.disarm()
+            await _repl_rig_down(rig)
+
+
+async def test_lease_renew_drop_exactly_one_takeover_no_duel():
+    """Drop-mode lease.renew silences the owner's renewals: the
+    standby promotes EXACTLY once, the superseded owner demotes (its
+    stale-epoch renewals are refused by every directory), and the map
+    never flaps afterward — no dueling owners."""
+    from nakama_tpu.cluster import (
+        FailoverMonitor,
+        LeaseManager,
+        ShardDirectory,
+    )
+
+    log = quiet_logger()
+    clock = [0.0]
+    dir_o = ShardDirectory("o1", ["o1"], lease_ms=1000,
+                           lease_grace_ms=1000,
+                           clock=lambda: clock[0], logger=log)
+    dir_s = ShardDirectory("sb", ["o1"], lease_ms=1000,
+                           lease_grace_ms=1000,
+                           clock=lambda: clock[0], logger=log)
+    lease_o = LeaseManager(dir_o, "o1", ["o1"], log)
+    lease_s = LeaseManager(dir_s, "sb", [], log)
+    mm_o = LocalMatchmaker(
+        log,
+        MatchmakerConfig(backend="cpu", pool_capacity=64,
+                         max_tickets=64),
+        node="o1",
+    )
+    demoted = []
+    lease_o.on_demoted = lambda *a: (demoted.append(a),
+                                     mm_o.pause())
+    monitor = FailoverMonitor(dir_s, lease_s, "o1", "sb", log)
+
+    def round_trip():
+        """One heartbeat round: owner's claims fold at the standby,
+        the standby's claims fold at the owner."""
+        for c in lease_o.heartbeat_payload().get("claims", ()):
+            dir_s.claim(c["shard"], c["node"], c["epoch"])
+        for c in lease_s.heartbeat_payload().get("claims", ()):
+            dir_o.claim(c["shard"], c["node"], c["epoch"])
+
+    try:
+        # Healthy rounds: renewals hold the lease on both sides.
+        for _ in range(3):
+            clock[0] += 0.5
+            round_trip()
+            assert not monitor.check()
+        mm_o.add([MatchmakerPresence("u", "s", node="f")],
+                 "s", "", "+properties.x:never", 2, 2)
+        # Renewals silenced: the lease decays at the standby while the
+        # owner keeps processing (it does not know it is silent).
+        faults.arm("lease.renew", "drop", probability=1.0)
+        takeovers = 0
+        for _ in range(6):
+            clock[0] += 0.5
+            round_trip()
+            mm_o.process()
+            if monitor.check():
+                await monitor.promote("lease_expired")
+                takeovers += 1
+        assert takeovers == 1  # exactly one takeover
+        assert faults.PLANE.fired.get("lease.renew", 0) > 0
+        # The owner's own renewals had bumped the seed epoch to 1, so
+        # the takeover mints epoch 2.
+        assert dir_s.owner_of("o1") == ("sb", 2)
+        faults.disarm("lease.renew")
+        # The old owner hears the higher epoch on the next round and
+        # DEMOTES: no duel — its renewals are refused, its matchmaker
+        # paused, and further rounds never flap the map back.
+        for _ in range(4):
+            clock[0] += 0.5
+            round_trip()
+        assert dir_o.owner_of("o1") == ("sb", 2)
+        assert demoted and demoted[0][0] == "o1"
+        assert lease_o.owned == set()
+        assert mm_o._paused
+        assert monitor.promotions == 1
+        assert dir_s.owner_of("o1") == ("sb", 2)  # stable, no flap
+    finally:
+        faults.disarm()
+        mm_o.stop()

@@ -224,11 +224,43 @@ def test_js_unsupported_syntax_is_loud():
         "class A {}",
         "let x = new Thing();",
         "let t = `template`;",
-        "function f(...rest) {}",
+        "function f(...rest, after) {}",  # rest must be last
         "let [a, b] = [1, 2];",
     ):
         with pytest.raises(JsSyntaxError):
             run(src)
+
+
+def test_js_rest_params():
+    """TS-compiled-style var-arg receivers (round-5 #9, the dual of
+    PR 10's spread-in-call work): `function f(...xs)` binds the tail
+    arguments as an array, including through arrows and re-spreads."""
+    out, _ = run(
+        """
+        // tsc es2015+ output style: a rest-param forwarder.
+        function tag(level, ...parts) {
+          return level + ":" + parts.join(",") + "/" + parts.length;
+        }
+        console.log(tag("info"));
+        console.log(tag("warn", "a"));
+        console.log(tag("err", "a", "b", "c"));
+        // Rest + spread round-trip (the forwarding idiom).
+        function sum() {
+          var t = 0;
+          for (var i = 0; i < arguments.length; i++) { t += arguments[i]; }
+          return t;
+        }
+        function forward(...xs) { return sum(...xs); }
+        console.log(forward(1, 2, 3, 4));
+        // Arrow rest params.
+        var pick = (first, ...others) => first + "|" + others.length;
+        console.log(pick("x", "y", "z"));
+        // arguments still sees EVERY argument alongside the binding.
+        function both(...xs) { return xs.length + arguments.length; }
+        console.log(both(1, 2));
+        """
+    )
+    assert out == ["info:/0", "warn:a/1", "err:a,b,c/3", "10", "x|2", "4"]
 
 
 def test_js_host_values_cross_by_conversion():

@@ -125,6 +125,11 @@ GOLDEN_EXPOSITION = {
     ("nakama_cluster_frames", "Counter", ("type", "direction")),
     ("nakama_cluster_peers", "Gauge", ("state",)),
     ("nakama_cluster_presence_sweeps", "Counter", ()),
+    ("nakama_cluster_shard_owner", "Gauge", ("shard",)),
+    ("nakama_lease_state", "Gauge", ("shard",)),
+    ("nakama_owner_takeovers", "Counter", ("reason",)),
+    ("nakama_replication_lag_lsn", "Gauge", ()),
+    ("nakama_replication_lag_sec", "Gauge", ()),
     ("nakama_db_write_batch_size", "Histogram", ()),
     ("nakama_db_write_queue_depth", "Gauge", ()),
     ("nakama_device_kernel_time_sec", "Histogram", ("kernel",)),
