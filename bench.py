@@ -3163,9 +3163,53 @@ async def _cluster_node_main():
         cfg.database.address = [spec["db"]]
     else:
         cfg.recovery.enabled = False
+    # Soak plane (PR 12): the in-process modeled-session tier runs
+    # inside the node; the parent reads its SLO table off the console.
+    lg = spec.get("loadgen") or {}
+    if lg.get("enabled"):
+        cfg.loadgen.enabled = True
+        cfg.loadgen.sessions = int(lg.get("sessions", 100))
+        cfg.loadgen.seed = int(lg.get("seed", 1))
+        cfg.loadgen.lifetime_mean_s = float(
+            lg.get("lifetime_mean_s", 20.0)
+        )
+        cfg.loadgen.lifetime_sigma = float(lg.get("lifetime_sigma", 0.8))
+        cfg.loadgen.arrival_rate_per_s = float(
+            lg.get("arrival_rate_per_s", 0.0)
+        )
+        cfg.loadgen.mix = list(lg.get("mix", []))
     server = NakamaServer(cfg)
+    # Every soak node can host the catalog's authoritative match: real
+    # clients create `soak_echo` matches on whichever frontend they
+    # land on (the engine registers it too; register is idempotent).
+    from nakama_tpu.loadgen import ECHO_MATCH_NAME, EchoMatchCore
+
+    server.match_registry.register(ECHO_MATCH_NAME, EchoMatchCore)
     await server.start()
     print(f"NODE_UP {cfg.name} {server.port}", flush=True)
+
+    async def _arm_leg(leg):
+        """Mid-run chaos: sleep to the leg's start, arm the point,
+        hold for its duration, disarm — the soak's chaos legs are
+        armed INSIDE the node on a pre-planned schedule."""
+        from nakama_tpu import faults
+
+        await asyncio.sleep(float(leg.get("after_s", 1.0)))
+        faults.arm(
+            leg["point"],
+            leg.get("mode", "raise"),
+            probability=float(leg.get("p", 1.0)),
+            seed=int(leg.get("seed", 1)),
+        )
+        print(f"CHAOS_ARMED {leg['point']}", flush=True)
+        await asyncio.sleep(float(leg.get("duration_s", 5.0)))
+        faults.disarm(leg["point"])
+        print(f"CHAOS_DISARMED {leg['point']}", flush=True)
+
+    arm_tasks = [
+        asyncio.get_running_loop().create_task(_arm_leg(leg))
+        for leg in (spec.get("arm") or [])
+    ]
     stop = asyncio.Event()
     import signal as _signal
 
@@ -3173,6 +3217,8 @@ async def _cluster_node_main():
     for sig in (_signal.SIGINT, _signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    for t in arm_tasks:
+        t.cancel()
     await server.stop()
 
 
@@ -3183,7 +3229,8 @@ class _ClusterNode:
                  interval_sec=1, cluster=True, db=None,
                  heartbeat_ms=200, down_after_ms=1200,
                  shards=None, standby_of="", lease_ms=2000,
-                 lease_grace_ms=3000, checkpoint_interval_sec=0):
+                 lease_grace_ms=3000, checkpoint_interval_sec=0,
+                 loadgen=None, arm=None):
         import tempfile
 
         self.name = name
@@ -3210,6 +3257,8 @@ class _ClusterNode:
             "lease_ms": lease_ms,
             "lease_grace_ms": lease_grace_ms,
             "checkpoint_interval_sec": checkpoint_interval_sec,
+            "loadgen": loadgen or {},
+            "arm": arm or [],
             "peers": peers,  # filled before spawn
         }
         self.proc = None
@@ -3617,7 +3666,9 @@ async def _cluster_bench_body(emit_json, all_metrics):
     return out
 
 
-async def _cluster_console(http, node):
+async def _console_get(http, node, path):
+    """Authenticated console GET on a child node (token cached on the
+    node handle) — shared by the cluster and soak snapshot readers."""
     token = getattr(node, "_console_token", None)
     if token is None:
         async with http.post(
@@ -3628,11 +3679,15 @@ async def _cluster_console(http, node):
             token = (await r.json())["token"]
         node._console_token = token
     async with http.get(
-        f"{node.console}/v2/console/cluster",
+        f"{node.console}{path}",
         headers={"Authorization": f"Bearer {token}"},
     ) as r:
         assert r.status == 200, (r.status, await r.text())
         return await r.json()
+
+
+async def _cluster_console(http, node):
+    return await _console_get(http, node, "/v2/console/cluster")
 
 
 async def _cluster_wait_converged(http, nodes, timeout=20.0):
@@ -4335,6 +4390,362 @@ def run_failover_main() -> int:
     return 1 if regression else 0
 
 
+# --------------------------------------------------------------------------
+# Million-session soak (PR 12): the whole product under load at once.
+# `bench.py --soak` boots a 4-node lab (owner shard + warm standby + 2
+# loadgen frontends), drives the full scenario catalog concurrently —
+# modeled tier in-process inside each frontend, real websocket tier
+# from this parent across BOTH frontends (every scenario cross-node) —
+# arms chaos legs mid-run (repl.ship drop, cluster.send raise, owner
+# SIGKILL with standby promotion), and judges the merged per-scenario
+# SLO table with the named `soak_slo_regression` in the single
+# bench_all_metrics tail line + rc. `--soak-minutes`/`--soak-sessions`
+# bound the tier-1 leg (~60s); the multi-hour 1M-session figure is
+# reproducible from the same entry point.
+# --------------------------------------------------------------------------
+
+
+def _soak_bounded_slos(duration_s: float, outage_s: float):
+    """Price the DELIBERATE chaos legs into a bounded leg's targets:
+    an owner kill costs ~lease+grace seconds of matchmaking
+    availability by design — over one minute that is a visible
+    fraction, over the multi-hour production run it vanishes (the
+    returned table converges to DEFAULT_SLOS as duration grows).
+    Returns (slos, burn_max_1h, chaos_frac)."""
+    from nakama_tpu.loadgen import DEFAULT_SLOS
+
+    chaos_frac = min(0.5, outage_s / max(1.0, duration_s))
+    slack = chaos_frac + 0.05  # + base jitter budget on this box
+    slos = {}
+    tightest = 1.0
+    for name, spec in DEFAULT_SLOS.items():
+        slos[name] = {
+            "availability": max(
+                0.5, round(spec["availability"] - slack, 4)
+            ),
+            # Co-located lab allowance: 4 server processes + the
+            # modeled population share ONE core here, and the kill/
+            # promotion window stalls every co-located event loop —
+            # the bounded leg doubles the latency bounds; the
+            # multi-hour run on real hardware judges the production
+            # numbers.
+            "p99_ms": spec["p99_ms"] * 2.0,
+        }
+        tightest = min(tightest, 1.0 - spec["availability"])
+    # Node judges compute burn against the DEFAULT targets; the cap
+    # must admit the same priced-in chaos fraction.
+    burn_max = max(1.0, round(1.0 + slack / max(1e-3, tightest), 2))
+    return slos, burn_max, chaos_frac
+
+
+async def _soak_console(http, node):
+    return await _console_get(http, node, "/v2/console/soak")
+
+
+async def _soak_bench_body(minutes: float, sessions: int, out: dict):
+    import signal as _signal
+    import tempfile
+
+    import aiohttp
+
+    from nakama_tpu.loadgen import (
+        RealSession,
+        SoakJudge,
+        run_real_catalog,
+    )
+    from nakama_tpu.loadgen import scenarios as _sc
+
+    duration = max(45.0, minutes * 60.0)
+    base_dir = tempfile.mkdtemp(prefix="bench-soak-")
+    lease_ms, grace_ms = 2000, 3000
+    per_node = max(2, sessions // 2)
+    lg = {
+        "enabled": True,
+        "sessions": per_node,
+        "lifetime_mean_s": 20.0,
+        "lifetime_sigma": 0.8,
+    }
+    # Chaos schedule, relative to node boot (boot+converge eats the
+    # slack before the first leg): ship-drop grows replication lag and
+    # must heal; send-raise refuses frontend forwards synchronously;
+    # the owner SIGKILL (parent-side, below) drives a real promotion.
+    boot_slack = 20.0
+    ship_leg = {
+        "point": "repl.ship", "mode": "drop", "p": 0.7,
+        "after_s": boot_slack + 0.20 * duration,
+        "duration_s": min(8.0, 0.10 * duration), "seed": 5,
+    }
+    send_leg = {
+        "point": "cluster.send", "mode": "raise", "p": 0.3,
+        "after_s": boot_slack + 0.40 * duration,
+        "duration_s": min(6.0, 0.08 * duration), "seed": 6,
+    }
+    o1 = _ClusterNode(
+        "o1", "device_owner", "", [], base_dir,
+        db=os.path.join(base_dir, "o1.db"), shards=["o1"],
+        lease_ms=lease_ms, lease_grace_ms=grace_ms,
+        checkpoint_interval_sec=10, arm=[ship_leg],
+    )
+    sb = _ClusterNode(
+        "sb", "standby", "", [], base_dir,
+        db=os.path.join(base_dir, "sb.db"), shards=["o1"],
+        standby_of="o1", lease_ms=lease_ms, lease_grace_ms=grace_ms,
+        checkpoint_interval_sec=10,
+    )
+    f1 = _ClusterNode(
+        "f1", "frontend", "", [], base_dir, shards=["o1"],
+        lease_ms=lease_ms, lease_grace_ms=grace_ms,
+        loadgen={**lg, "seed": 21},
+    )
+    f2 = _ClusterNode(
+        "f2", "frontend", "", [], base_dir, shards=["o1"],
+        lease_ms=lease_ms, lease_grace_ms=grace_ms,
+        loadgen={**lg, "seed": 22}, arm=[send_leg],
+    )
+    nodes = {n.name: n for n in (o1, sb, f1, f2)}
+    for n in nodes.values():
+        n.spec["peers"] = [
+            f"{p.name}=127.0.0.1:{p.bus_port}"
+            for p in nodes.values() if p is not n
+        ]
+        n.spawn()
+    driver_judge = SoakJudge(node="driver")
+    reals: list = []
+    async with aiohttp.ClientSession() as http:
+        try:
+            for n in nodes.values():
+                await n.wait_healthy(http)
+            await _cluster_wait_converged(http, list(nodes.values()))
+            # Real-socket tier: 8 clients alternating frontends, so
+            # every catalog scenario's lead and first partner sit on
+            # different nodes.
+            for i in range(8):
+                base = (f1 if i % 2 == 0 else f2).base
+                s = RealSession(
+                    driver_judge,
+                    "f1" if i % 2 == 0 else "f2",
+                    i,
+                    http,
+                    base,
+                )
+                await s.open(f"bench-soak-real-{i:04d}xx")
+                reals.append(s)
+            t0 = time.perf_counter()
+            t_end = t0 + duration
+            killed = False
+            rounds = 0
+            while time.perf_counter() < t_end:
+                await run_real_catalog(list(reals))
+                rounds += 1
+                if (
+                    not killed
+                    and time.perf_counter() - t0 > 0.60 * duration
+                ):
+                    # The big chaos leg: SIGKILL the owner mid-soak —
+                    # the warm standby promotes (PR 11) and the soak
+                    # keeps going on the promoted owner.
+                    o1.kill(_signal.SIGKILL)
+                    killed = True
+                    out["owner_killed_at_s"] = round(
+                        time.perf_counter() - t0, 1
+                    )
+            out["real_rounds"] = rounds
+            # Heal proof: one final cross-node matchmake episode must
+            # succeed on the PROMOTED owner. A failed promotion must
+            # land as the gated regression verdict, never a crash —
+            # the episode's own internal budget is ~70s (2 adds + 2
+            # matched waits), so the hard stop sits above it.
+            for s in reals[:2]:
+                s.scenario = "matchmake_solo"
+            before_ok = driver_judge.table()["matchmake_solo"]["ok"]
+            try:
+                await asyncio.wait_for(
+                    _sc.matchmake_solo(reals[0], [reals[1]]),
+                    timeout=90,
+                )
+            except Exception:
+                pass  # judged below by the ok-count delta
+            healed = (
+                driver_judge.table()["matchmake_solo"]["ok"]
+                >= before_ok + 4
+            )
+            out["healed_on_promoted_owner"] = healed
+            # Drain each socket so late matched envelopes land in the
+            # audit before it runs.
+            for s in reals:
+                while await s._recv(0.3) is not None:
+                    pass
+            unresolved = 0
+            for s in reals:
+                unresolved += len(
+                    set(s.acked_tickets) - set(s.matched_tickets)
+                )
+            pooled = 0
+            for n in (sb, o1):
+                try:
+                    snap = await _cluster_console(http, n)
+                    pooled += snap.get("matchmaker_tickets", 0)
+                except Exception:
+                    pass  # o1 is dead by design
+            out["real_acked_unresolved"] = unresolved
+            out["pooled_at_survivors"] = pooled
+            out["lost_acked_ops"] = max(0, unresolved - pooled)
+            # Per-node modeled-tier tables + session stats off the
+            # console; the driver's real-tier table joins the merge.
+            node_tables = []
+            node_sessions = []
+            for n in (f1, f2):
+                snap = await _soak_console(http, n)
+                node_tables.append(snap.get("slo_table") or {})
+                node_sessions.append(snap.get("sessions") or {})
+            out["node_sessions"] = node_sessions
+            out["driver_table"] = driver_judge.table()
+            out["node_tables"] = node_tables
+            out["modeled_sessions_spawned"] = sum(
+                s.get("spawned", 0) for s in node_sessions
+            )
+            out["modeled_sessions_shed"] = sum(
+                s.get("shed", 0) for s in node_sessions
+            )
+        finally:
+            for s in reals:
+                try:
+                    await s.close()
+                except Exception:
+                    pass
+            for n in nodes.values():
+                n.stop()
+    return out
+
+
+def run_soak_main() -> int:
+    """`bench.py --soak`: the whole-product soak — mixed scenario
+    traffic over a 4-node lab, chaos legs armed mid-run, judged by the
+    per-scenario SLO table under the named tier-1-unit-tested
+    `soak_slo_regression` in the single bench_all_metrics tail + rc."""
+    import asyncio
+
+    from nakama_tpu.loadgen import merge_tables, soak_slo_regression
+
+    argv = sys.argv[1:]
+
+    def _flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        env = os.environ.get(
+            "BENCH_SOAK_" + name.strip("-").split("-", 1)[1].upper()
+        )
+        return cast(env) if env else default
+
+    minutes = _flag("--soak-minutes", 1.0, float)
+    sessions = _flag("--soak-sessions", 160, int)
+    duration = max(45.0, minutes * 60.0)
+    out: dict = {"minutes": minutes, "sessions": sessions}
+    asyncio.run(_soak_bench_body(minutes, sessions, out))
+    merged = merge_tables(
+        [out["driver_table"], *out["node_tables"]]
+    )
+    out["slo_table"] = merged
+    # The deliberate-outage budget: owner kill (lease + grace until
+    # promotion) + the send-raise leg's expected refusal window.
+    outage_s = (2000 + 3000) / 1000.0 + 6.0 * 0.3
+    slos, burn_max, chaos_frac = _soak_bounded_slos(duration, outage_s)
+    reasons, regression = soak_slo_regression(
+        merged,
+        slos,
+        min_ops=2,
+        require_tiers=("real",),
+        lost_acked_ops=out["lost_acked_ops"],
+        burn_max_1h=burn_max,
+    )
+    if not out.get("healed_on_promoted_owner"):
+        reasons.append(
+            "post-kill matchmake on the promoted owner failed"
+        )
+        regression = True
+    all_metrics: dict[str, dict] = {}
+
+    def emit_json(obj: dict):
+        print(json.dumps(obj), flush=True)
+        all_metrics[obj["metric"]] = obj
+
+    for name, row in sorted(merged.items()):
+        emit_json(
+            {
+                "metric": f"soak_{name}",
+                "value": row["availability"],
+                "unit": "availability",
+                "ops": row["ops"],
+                "p99_ms": row["p99_ms"],
+                "burn_1h": row["burn_1h"],
+                "internal_errors": row["internal_errors"],
+                "by_tier": row["by_tier"],
+            }
+        )
+    emit_json(
+        {
+            "metric": "soak_population",
+            "value": out["modeled_sessions_spawned"],
+            "unit": "modeled sessions spawned",
+            "real_sessions": 8,
+            "shed": out["modeled_sessions_shed"],
+            "real_rounds": out.get("real_rounds", 0),
+            "duration_s": duration,
+            "note": (
+                "two-tier population: modeled in-process sessions"
+                " inside each frontend + 8 real websocket clients"
+                " driven cross-node by the parent (tiers never"
+                " conflated in the table)"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "soak_zero_loss_audit",
+            "value": out["lost_acked_ops"],
+            "unit": "acked ops lost",
+            "unresolved": out["real_acked_unresolved"],
+            "pooled_at_survivors": out["pooled_at_survivors"],
+            "owner_killed_at_s": out.get("owner_killed_at_s"),
+            "healed_on_promoted_owner": out.get(
+                "healed_on_promoted_owner"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "soak_slo_regression",
+            "value": regression,
+            "reasons": reasons,
+            "chaos_frac_priced_in": round(chaos_frac, 4),
+            "burn_max_1h": burn_max,
+            "note": (
+                "named gate (tier-1-unit-tested): full catalog"
+                " coverage on BOTH tiers, zero internal-error"
+                " responses, zero acknowledged-op loss across the"
+                " chaos legs (repl.ship drop + cluster.send raise +"
+                " owner SIGKILL), per-scenario availability/p99/burn"
+                " within the SLO table (bounded legs price the"
+                " deliberate outage in; multi-hour runs converge to"
+                " the production targets)"
+            ),
+        }
+    )
+    print(
+        json.dumps(
+            {"metric": "bench_all_metrics", "metrics": all_metrics}
+        ),
+        flush=True,
+    )
+    if regression:
+        print(
+            f"FAIL: soak SLO regression: {'; '.join(reasons)}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return 1 if regression else 0
+
+
 def main():
     import numpy as np
 
@@ -4345,6 +4756,12 @@ def main():
 
         asyncio.run(_cluster_node_main())
         return 0
+    if "--soak" in sys.argv[1:] or os.environ.get("BENCH_SOAK"):
+        # Whole-product soak: mixed scenario traffic on a 4-node lab,
+        # chaos legs armed mid-run, judged by the per-scenario SLO
+        # table — separable from the perf sampling like --cluster,
+        # verdict in the same bench_all_metrics tail line.
+        return run_soak_main()
     if "--failover" in sys.argv[1:] or os.environ.get(
         "BENCH_FAILOVER"
     ):

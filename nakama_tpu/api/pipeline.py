@@ -416,8 +416,65 @@ class Pipeline:
         handler = registry.get(match_id) if registry is not None else None
         if handler is not None:
             await self._join_authoritative(session, cid, match_id, metadata)
-        else:
-            self._join_relayed(session, cid, match_id)
+            return
+        # Clustered registry: the id may name an authoritative match on
+        # a peer node — admission runs there; a miss falls back to the
+        # relayed path exactly like a local miss.
+        if registry is not None and getattr(
+            registry, "remote_node_of", None
+        ) is not None and registry.remote_node_of(match_id):
+            if await self._join_remote_authoritative(
+                session, cid, match_id, metadata
+            ):
+                return
+        self._join_relayed(session, cid, match_id)
+
+    async def _join_remote_authoritative(
+        self, session, cid, match_id, metadata
+    ) -> bool:
+        """Cross-node authoritative join: admission RPC at the match's
+        authority node, then a LOCAL track whose replication delivers
+        the join to the match task there. Returns False when no
+        authoritative match by that id exists remotely."""
+        from ..match import MatchError
+
+        registry = self.c.match_registry
+        stream = Stream(StreamMode.MATCH_AUTHORITATIVE, subject=match_id)
+        presence = self._presence_for(session, stream)
+        try:
+            res = await registry.join_attempt_remote(
+                match_id, presence, metadata
+            )
+        except MatchError as e:
+            raise PipelineError(str(e)) from e
+        if not res.get("found"):
+            return False
+        if not res.get("allow"):
+            session.send(
+                error(
+                    ErrorCode.MATCH_JOIN_REJECTED,
+                    res.get("reason") or "join rejected",
+                    cid,
+                )
+            )
+            return True
+        self._leave_other_matches(session, match_id)
+        self.c.tracker.track(
+            session.id, stream, session.user_id, presence.meta
+        )
+        out = {
+            "match": {
+                "match_id": match_id,
+                "authoritative": True,
+                "label": res.get("label", ""),
+                "presences": list(res.get("presences") or []),
+                "self": presence.as_dict(),
+            }
+        }
+        if cid:
+            out["cid"] = cid
+        session.send(out)
+        return True
 
     def _leave_other_matches(self, session, joining_id: str):
         """session.single_match: joining a match leaves any previous one
@@ -549,6 +606,28 @@ class Pipeline:
                 bool(body.get("reliable", True)),
             )
             return
+        # Cross-node authoritative data: the session is tracked in the
+        # MATCH_AUTHORITATIVE stream (it joined via the remote path) but
+        # the handler lives on a peer — forward one frame to it.
+        if registry is not None and getattr(
+            registry, "remote_node_of", None
+        ) is not None and registry.remote_node_of(match_id):
+            auth_stream = Stream(
+                StreamMode.MATCH_AUTHORITATIVE, subject=match_id
+            )
+            presence = self.c.tracker.get_by_stream_user(
+                auth_stream, session.id
+            )
+            if presence is not None:
+                if not registry.send_data(
+                    match_id,
+                    presence,
+                    op_code,
+                    _b64_bytes(data),
+                    bool(body.get("reliable", True)),
+                ):
+                    raise PipelineError("match node unavailable")
+                return
         stream = Stream(StreamMode.MATCH_RELAYED, subject=match_id)
         sender = self.c.tracker.get_by_stream_user(stream, session.id)
         if sender is None:
@@ -581,6 +660,22 @@ class Pipeline:
             raise PipelineError("party not found")
         return handler
 
+    def _note_party_op(self, op: str, handler=None):
+        """Party-operation accounting: op name + whether it crossed the
+        bus to a remote authority (cluster/ops.py proxies mark
+        themselves `is_remote`)."""
+        m = self.c.metrics
+        if m is None:
+            return
+        m.cluster_party_ops.labels(
+            op=op,
+            crossed=(
+                "true"
+                if getattr(handler, "is_remote", False)
+                else "false"
+            ),
+        ).inc()
+
     def _h_party_create(self, session, cid, body):
         """Reference pipeline_party.go partyCreate."""
         registry = _require(self.c.party_registry, "party registry")
@@ -598,27 +693,46 @@ class Pipeline:
             session.id, handler.stream, session.user_id, presence.meta
         )
         handler.on_joins([presence])
+        self._note_party_op("create", handler)
         out = {"party": {**handler.as_dict(), "self": presence.as_dict()}}
         if cid:
             out["cid"] = cid
         session.send(out)
 
-    def _h_party_join(self, session, cid, body):
+    async def _h_party_join(self, session, cid, body):
+        """Join runs the admission check at the party's authority node
+        (local handler or cross-node proxy — cluster/ops.py), then
+        tracks LOCALLY: the replicated presence event carries the
+        membership to the authority, one source of truth either way."""
         handler = self._party(body.get("party_id", ""))
 
         stream = handler.stream
         presence = self._presence_for(session, stream)
         try:
-            allowed = handler.request_join(presence)
+            allowed = await _maybe_await(handler.request_join(presence))
         except PartyError as e:
             raise PipelineError(str(e)) from e
+        self._note_party_op("join", handler)
         if allowed:
             self._leave_other_parties(session.id, handler.party_id)
             self.c.tracker.track(
                 session.id, stream, session.user_id, presence.meta
             )
-            handler.on_joins([presence])
-            out = {"party": {**handler.as_dict(), "self": presence.as_dict()}}
+            if not handler.is_remote:
+                handler.on_joins([presence])
+                pd = handler.as_dict()
+            else:
+                # Envelope fidelity: make sure the joiner shows in the
+                # presence list even if the authority's snapshot was
+                # taken before it registered there.
+                pd = handler.as_dict()
+                ps = list(pd.get("presences") or [])
+                if not any(
+                    q.get("session_id") == session.id for q in ps
+                ):
+                    ps.append(presence.as_dict())
+                pd = {**pd, "presences": ps}
+            out = {"party": {**pd, "self": presence.as_dict()}}
             if cid:
                 out["cid"] = cid
             session.send(out)
@@ -627,116 +741,156 @@ class Pipeline:
 
     def _h_party_leave(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
+        self._note_party_op("leave", handler)
         self.c.tracker.untrack(session.id, handler.stream)
         if cid:
             session.send({"cid": cid})
 
-    def _h_party_promote(self, session, cid, body):
+    async def _h_party_promote(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
 
         try:
-            handler.promote(session.id, body.get("presence") or {})
+            await _maybe_await(
+                handler.promote(session.id, body.get("presence") or {})
+            )
         except PartyError as e:
             raise PipelineError(str(e)) from e
+        self._note_party_op("promote", handler)
         if cid:
             session.send({"cid": cid})
 
-    def _h_party_accept(self, session, cid, body):
+    async def _h_party_accept(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
 
         try:
-            presence = handler.accept(session.id, body.get("presence") or {})
+            presence = await _maybe_await(
+                handler.accept(session.id, body.get("presence") or {})
+            )
         except PartyError as e:
             raise PipelineError(str(e)) from e
-        # Track on behalf of the accepted session (reference uses the
-        # stream manager for this, party_handler.go accept flow).
-        target = (
-            self.c.session_registry.get(presence.id.session_id)
-            if self.c.session_registry is not None
-            else None
-        )
-        if target is None:
-            raise PipelineError("accepted session gone")
-        self._leave_other_parties(
-            presence.id.session_id, handler.party_id
-        )
-        self.c.tracker.track(
-            presence.id.session_id,
-            handler.stream,
-            presence.user_id,
-            presence.meta,
-        )
-        handler.on_joins([presence])
-        out = {
-            "party": {**handler.as_dict(), "self": presence.as_dict()}
-        }
-        target.send(out)
+        self._note_party_op("accept", handler)
+        if presence is not None:
+            # Local authority: adopt the accepted session — on ITS node
+            # when the registry is clustered (session may live on a
+            # peer), inline otherwise.
+            registry = self.c.party_registry
+            adopt = getattr(registry, "adopt", None)
+            if adopt is not None:
+                try:
+                    adopt(handler, presence)
+                except PartyError as e:
+                    raise PipelineError(str(e)) from e
+            else:
+                target = (
+                    self.c.session_registry.get(presence.id.session_id)
+                    if self.c.session_registry is not None
+                    else None
+                )
+                if target is None:
+                    raise PipelineError("accepted session gone")
+                self._leave_other_parties(
+                    presence.id.session_id, handler.party_id
+                )
+                self.c.tracker.track(
+                    presence.id.session_id,
+                    handler.stream,
+                    presence.user_id,
+                    presence.meta,
+                )
+                handler.on_joins([presence])
+                target.send(
+                    {
+                        "party": {
+                            **handler.as_dict(),
+                            "self": presence.as_dict(),
+                        }
+                    }
+                )
         if cid:
             session.send({"cid": cid})
 
-    def _h_party_remove(self, session, cid, body):
+    async def _h_party_remove(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
 
         try:
-            removed = handler.remove(session.id, body.get("presence") or {})
+            removed = await _maybe_await(
+                handler.remove(session.id, body.get("presence") or {})
+            )
         except PartyError as e:
             raise PipelineError(str(e)) from e
+        self._note_party_op("remove", handler)
         if removed is not None:
-            self.c.tracker.untrack(removed.id.session_id, handler.stream)
+            self.c.party_registry.untrack_presence(
+                removed, handler.stream
+            )
         if cid:
             session.send({"cid": cid})
 
-    def _h_party_close(self, session, cid, body):
+    async def _h_party_close(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
 
         try:
-            handler.close(session.id, self.c.tracker)
+            await _maybe_await(handler.close(session.id, self.c.tracker))
         except PartyError as e:
             raise PipelineError(str(e)) from e
+        self._note_party_op("close", handler)
         self.c.party_registry.remove(handler.party_id)
         if cid:
             session.send({"cid": cid})
 
-    def _h_party_join_request_list(self, session, cid, body):
+    async def _h_party_join_request_list(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
 
         try:
-            pending = handler.join_request_list(session.id)
+            pending = await _maybe_await(
+                handler.join_request_list(session.id)
+            )
         except PartyError as e:
             raise PipelineError(str(e)) from e
+        self._note_party_op("list_requests", handler)
         out = {
             "party_join_request": {
                 "party_id": handler.party_id,
-                "presences": [p.as_dict() for p in pending],
+                "presences": [
+                    p if isinstance(p, dict) else p.as_dict()
+                    for p in pending
+                ],
             }
         }
         if cid:
             out["cid"] = cid
         session.send(out)
 
-    def _h_party_matchmaker_add(self, session, cid, body):
+    async def _h_party_matchmaker_add(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
         from ..matchmaker import MatchmakerError
 
         min_count, max_count, multiple = _validate_counts(body)
         try:
-            ticket = handler.matchmaker_add(
-                session.id,
-                body.get("query") or "*",
-                min_count,
-                max_count,
-                multiple,
-                {
-                    k: str(v)
-                    for k, v in (body.get("string_properties") or {}).items()
-                },
-                {
-                    k: float(v)
-                    for k, v in (body.get("numeric_properties") or {}).items()
-                },
+            ticket = await _maybe_await(
+                handler.matchmaker_add(
+                    session.id,
+                    body.get("query") or "*",
+                    min_count,
+                    max_count,
+                    multiple,
+                    {
+                        k: str(v)
+                        for k, v in (
+                            body.get("string_properties") or {}
+                        ).items()
+                    },
+                    {
+                        k: float(v)
+                        for k, v in (
+                            body.get("numeric_properties") or {}
+                        ).items()
+                    },
+                )
             )
         except (PartyError, MatchmakerError) as e:
             raise PipelineError(str(e) or type(e).__name__) from e
+        self._note_party_op("mm_add", handler)
         out = {
             "party_matchmaker_ticket": {
                 "party_id": handler.party_id,
@@ -747,32 +901,40 @@ class Pipeline:
             out["cid"] = cid
         session.send(out)
 
-    def _h_party_matchmaker_remove(self, session, cid, body):
+    async def _h_party_matchmaker_remove(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
         from ..matchmaker import MatchmakerError
 
         try:
-            handler.matchmaker_remove(session.id, body.get("ticket", ""))
+            await _maybe_await(
+                handler.matchmaker_remove(
+                    session.id, body.get("ticket", "")
+                )
+            )
         except (PartyError, MatchmakerError) as e:
             raise PipelineError(str(e) or type(e).__name__) from e
+        self._note_party_op("mm_remove", handler)
         if cid:
             session.send({"cid": cid})
 
-    def _h_party_data_send(self, session, cid, body):
+    async def _h_party_data_send(self, session, cid, body):
         handler = self._party(body.get("party_id", ""))
 
         try:
             # Same bytes-field contract as match data: validate and
             # canonicalize the base64 before relaying to members.
-            handler.data_send(
-                session.id,
-                int(body.get("op_code", 0)),
-                base64.b64encode(
-                    _b64_bytes(body.get("data", ""))
-                ).decode("ascii"),
+            await _maybe_await(
+                handler.data_send(
+                    session.id,
+                    int(body.get("op_code", 0)),
+                    base64.b64encode(
+                        _b64_bytes(body.get("data", ""))
+                    ).decode("ascii"),
+                )
             )
         except PartyError as e:
             raise PipelineError(str(e)) from e
+        self._note_party_op("data", handler)
 
     # ------------------------------------------------------------- channel
 

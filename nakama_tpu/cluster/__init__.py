@@ -26,6 +26,13 @@ from .matchmaker import (
     cluster_matched_handler,
 )
 from .membership import Membership
+from .ops import (
+    BusRpc,
+    ClusterMatchRegistry,
+    ClusterOpError,
+    ClusterPartyRegistry,
+    RemotePartyHandler,
+)
 from .plane import ClusterPlane, cluster_peers_signal
 from .presence import (
     ClusterMessageRouter,
@@ -37,15 +44,20 @@ from .replication import JournalShipper, ReplicationApplier
 from .sharding import ShardDirectory, rendezvous_shard, shard_key
 
 __all__ = [
+    "BusRpc",
     "ClusterBus",
     "ClusterPeerDown",
+    "ClusterMatchRegistry",
     "ClusterMatchmakerClient",
     "ClusterMatchmakerIngest",
     "ClusterMessageRouter",
+    "ClusterOpError",
+    "ClusterPartyRegistry",
     "ClusterPlane",
     "ClusterSessionRegistry",
     "ClusterStreamManager",
     "ClusterTracker",
+    "RemotePartyHandler",
     "FailoverMonitor",
     "JournalShipper",
     "LeaseManager",

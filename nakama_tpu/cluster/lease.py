@@ -275,13 +275,24 @@ class FailoverMonitor:
                 self.membership.beat_now()
             except Exception:
                 pass
-        if self.mm is not None and getattr(self.mm, "_task", None) is None:
-            try:
-                self.mm.start()
-            except Exception as e:
-                self.logger.error(
-                    "promoted matchmaker failed to start", error=str(e)
-                )
+        if self.mm is not None:
+            # A re-subordinated former owner promotes BACK with its
+            # interval task still alive but paused — resume covers it;
+            # a configured standby's never-started pool needs start().
+            resume = getattr(self.mm, "resume", None)
+            if resume is not None:
+                try:
+                    resume()
+                except Exception:
+                    pass
+            if getattr(self.mm, "_task", None) is None:
+                try:
+                    self.mm.start()
+                except Exception as e:
+                    self.logger.error(
+                        "promoted matchmaker failed to start",
+                        error=str(e),
+                    )
         # Settle the adopted pool into OUR durable story: one immediate
         # checkpoint so a crash of the promoted owner replays nothing
         # of the old owner's (its journal rows live in another node's

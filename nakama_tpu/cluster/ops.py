@@ -1,0 +1,655 @@
+"""Cross-node party and match OPERATIONS over the cluster bus.
+
+PR 10 clustered the *view* (replicated presence, routed fan-out) and
+PR 11 the *pool* (sharded owners); this module clusters the remaining
+interactive surfaces: party create/join/promote/accept/data and
+authoritative-match join/data-send now work when the participating
+sessions live on different frontend nodes. The authority model follows
+the reference's clustered edition: a party or authoritative match is
+OWNED by the node embedded in its id (``<uuid>.<node>``) — every
+operation routes to that node and executes against the one live
+handler there, so leader checks, capacity checks and the match loop
+stay single-writer.
+
+Membership, on the other hand, stays where PR 10 put it: the tracker.
+A joining session tracks the PARTY / MATCH_AUTHORITATIVE stream on its
+OWN node; presence replication delivers the join event to the
+authority, whose existing tracker listeners (`party_registry
+.join_listener`, `match_registry.join_listener`) apply it exactly like
+a local join. One source of truth — a node death sweeps members
+through the same leave events a voluntary disconnect fires. The cost
+is a small admission window: between the authority's capacity check
+and the replicated track event, concurrent joiners can transiently
+overfill a party by the number of in-flight joins (the same window the
+reference's cross-node registry has).
+
+Request/response rides `BusRpc`, a correlation-id layer over the
+fire-and-forget frame bus: ``op.req``/``op.res`` frames, futures keyed
+by request id, bounded timeouts. Failure semantics are the PR 3
+posture: a down authority costs the *operation* (a typed error the
+pipeline answers with; the client retries), never a wedged session or
+an unbounded queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import itertools
+import time
+
+from ..logger import Logger
+from ..match.core import MatchMessage
+from ..match.party import LocalPartyRegistry, PartyError
+from ..match.registry import LocalMatchRegistry, MatchError
+from ..realtime import Stream, StreamMode
+from .presence import (
+    _presence_from_wire,
+    _presence_to_wire,
+    _stream_from_wire,
+    _stream_to_wire,
+)
+
+DEFAULT_OP_TIMEOUT_S = 5.0
+
+
+class ClusterOpError(Exception):
+    """A cross-node operation failed. `kind` routes the error back to
+    the caller's domain exception: not_found/party/match map onto
+    PartyError/MatchError; unavailable/timeout are the degradation
+    posture (peer down, frame lost — retryable)."""
+
+    def __init__(self, message: str, kind: str = "error"):
+        super().__init__(message)
+        self.kind = kind
+
+
+def owner_node_of(entity_id: str) -> str:
+    """The authority node embedded in a party/match id
+    (``<uuid>.<node>``); empty when the id carries none."""
+    _, sep, node = entity_id.rpartition(".")
+    return node if sep else ""
+
+
+class BusRpc:
+    """Correlated request/response over the cluster bus.
+
+    One instance per node; components register named op handlers
+    (sync or async, called as ``handler(src_node, body) -> dict``) and
+    call peers with `call()`. Handler domain errors travel back as
+    ``(kind, message)`` and re-raise as ClusterOpError at the caller —
+    never as a bus-level failure."""
+
+    def __init__(self, bus, node: str, logger: Logger, metrics=None,
+                 timeout_s: float = DEFAULT_OP_TIMEOUT_S):
+        self.bus = bus
+        self.node = node
+        self.logger = logger.with_fields(subsystem="cluster.rpc")
+        self.metrics = metrics
+        self.timeout_s = timeout_s
+        self._seq = itertools.count(1)
+        self._pending: dict[str, asyncio.Future] = {}
+        self._handlers: dict[str, object] = {}
+        bus.on("op.req", self._on_req)
+        bus.on("op.res", self._on_res)
+
+    def register(self, op: str, handler) -> None:
+        self._handlers[op] = handler
+
+    async def call(self, peer: str, op: str, body: dict,
+                   timeout: float | None = None) -> dict:
+        rid = f"{self.node}:{next(self._seq)}"
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            try:
+                sent = self.bus.send(
+                    peer, "op.req", {"id": rid, "op": op, "b": body}
+                )
+            except Exception as e:
+                # Raise-mode send fault / bus teardown: the OPERATION
+                # fails typed, the caller's session never sees an
+                # internal error.
+                raise ClusterOpError(
+                    f"node {peer} unreachable for {op}: {e}",
+                    "unavailable",
+                ) from e
+            if not sent:
+                raise ClusterOpError(
+                    f"node {peer} unreachable for {op}", "unavailable"
+                )
+            try:
+                res = await asyncio.wait_for(
+                    fut, timeout if timeout is not None else self.timeout_s
+                )
+            except asyncio.TimeoutError:
+                raise ClusterOpError(
+                    f"{op} timed out at node {peer}", "timeout"
+                ) from None
+        finally:
+            self._pending.pop(rid, None)
+        if not res.get("ok"):
+            raise ClusterOpError(
+                res.get("error") or op, res.get("kind", "error")
+            )
+        return res.get("b") or {}
+
+    async def _on_req(self, src: str, d: dict) -> None:
+        rid = d.get("id", "")
+        op = d.get("op", "")
+        handler = self._handlers.get(op)
+        try:
+            if handler is None:
+                raise ClusterOpError(f"unknown op {op!r}", "not_found")
+            out = handler(src, d.get("b") or {})
+            if asyncio.iscoroutine(out):
+                out = await out
+            res = {"id": rid, "ok": True, "b": out or {}}
+        except ClusterOpError as e:
+            res = {"id": rid, "ok": False, "error": str(e), "kind": e.kind}
+        except PartyError as e:
+            res = {"id": rid, "ok": False, "error": str(e), "kind": "party"}
+        except MatchError as e:
+            res = {"id": rid, "ok": False, "error": str(e), "kind": "match"}
+        except Exception as e:
+            # An operation error costs that operation, never the reader
+            # — and never leaks a traceback across the wire.
+            self.logger.error(
+                "cluster op handler error", op=op, src=src, error=str(e)
+            )
+            res = {
+                "id": rid, "ok": False,
+                "error": f"{type(e).__name__}: {e}", "kind": "error",
+            }
+        try:
+            self.bus.send(src, "op.res", res)
+        except Exception:
+            pass  # a lost response times out at the caller, typed
+
+    def _on_res(self, src: str, d: dict) -> None:
+        fut = self._pending.get(d.get("id", ""))
+        if fut is not None and not fut.done():
+            fut.set_result(d)
+
+
+# ---------------------------------------------------------------- party
+
+
+def _raise_party(e: ClusterOpError):
+    """Fold a cross-node failure back into the party domain so the
+    pipeline's existing PartyError handling answers it."""
+    raise PartyError(str(e)) from e
+
+
+class RemotePartyHandler:
+    """Pipeline-facing proxy for a party whose authority lives on
+    another node. Methods mirror PartyHandler's surface but are async
+    (one `party.op` RPC each); `as_dict` serves the last party snapshot
+    the authority returned."""
+
+    is_remote = True
+
+    def __init__(self, registry: "ClusterPartyRegistry", party_id: str,
+                 node: str):
+        self.registry = registry
+        self.party_id = party_id
+        self.node = node
+        self.stream = Stream(StreamMode.PARTY, subject=party_id)
+        self._dict: dict | None = None
+
+    def as_dict(self) -> dict:
+        return dict(
+            self._dict
+            or {
+                "party_id": self.party_id,
+                "open": True,
+                "max_size": 0,
+                "self": None,
+                "leader": None,
+                "presences": [],
+            }
+        )
+
+    async def _call(self, op: str, body: dict) -> dict:
+        body = {"pid": self.party_id, "op": op, **body}
+        try:
+            res = await self.registry.rpc.call(
+                self.node, "party.op", body
+            )
+        except ClusterOpError as e:
+            _raise_party(e)
+        if "party" in res:
+            self._dict = res["party"]
+        return res
+
+    async def request_join(self, presence) -> bool:
+        res = await self._call(
+            "join", {"presence": _presence_to_wire(presence)}
+        )
+        return bool(res.get("allowed"))
+
+    async def accept(self, leader_session: str, presence_dict: dict):
+        """The authority pops the request AND adopts the acceptee
+        (tracks on the acceptee's own node); nothing to do locally, so
+        this returns None — the pipeline skips its local adopt."""
+        await self._call(
+            "accept", {"sid": leader_session, "presence": presence_dict}
+        )
+        return None
+
+    async def remove(self, leader_session: str, presence_dict: dict):
+        """Removal untracks at the authority (routed to the member's
+        node); returns None so the pipeline skips its local untrack."""
+        await self._call(
+            "remove", {"sid": leader_session, "presence": presence_dict}
+        )
+        return None
+
+    async def promote(self, leader_session: str, presence_dict: dict):
+        await self._call(
+            "promote", {"sid": leader_session, "presence": presence_dict}
+        )
+
+    async def join_request_list(self, leader_session: str) -> list[dict]:
+        res = await self._call("list_requests", {"sid": leader_session})
+        return list(res.get("presences") or [])
+
+    async def close(self, leader_session: str, tracker=None):
+        await self._call("close", {"sid": leader_session})
+
+    async def data_send(self, sender_session: str, op_code: int,
+                        data: str):
+        await self._call(
+            "data",
+            {"sid": sender_session, "op_code": int(op_code), "data": data},
+        )
+
+    async def matchmaker_add(self, session_id: str, query: str,
+                             min_count: int, max_count: int,
+                             count_multiple: int = 1,
+                             string_properties: dict | None = None,
+                             numeric_properties: dict | None = None) -> str:
+        res = await self._call(
+            "mm_add",
+            {
+                "sid": session_id,
+                "query": query,
+                "min_count": int(min_count),
+                "max_count": int(max_count),
+                "count_multiple": int(count_multiple),
+                "sp": string_properties or {},
+                "np": numeric_properties or {},
+            },
+        )
+        return res.get("ticket", "")
+
+    async def matchmaker_remove(self, session_id: str, ticket: str):
+        await self._call(
+            "mm_remove", {"sid": session_id, "ticket": ticket}
+        )
+
+
+class ClusterPartyRegistry(LocalPartyRegistry):
+    """LocalPartyRegistry + cross-node operation routing.
+
+    Local parties behave exactly as before. `get()` on a foreign party
+    id returns a RemotePartyHandler proxy; the authority side executes
+    ops against its live handler inside the `party.op` RPC. Member
+    untracks route to the owning session's node (`pt.untrack`), and a
+    leader-accepted join is adopted on the acceptee's node
+    (`pt.adopt`) — the one case where a third node must act."""
+
+    def __init__(self, logger: Logger, tracker, router, matchmaker=None,
+                 node: str = "local", max_party_size: int = 256,
+                 bus=None, rpc: BusRpc | None = None,
+                 session_registry=None, config=None):
+        super().__init__(
+            logger, tracker, router, matchmaker, node, max_party_size
+        )
+        self.bus = bus
+        self.rpc = rpc
+        self.session_registry = session_registry
+        self.config = config
+        if rpc is not None:
+            rpc.register("party.op", self._on_party_op)
+        if bus is not None:
+            bus.on("pt.untrack", self._on_untrack)
+            bus.on("pt.adopt", self._on_adopt)
+
+    # ------------------------------------------------------------ lookup
+
+    def get(self, party_id: str):
+        handler = self._parties.get(party_id)
+        if handler is not None:
+            return handler
+        node = owner_node_of(party_id)
+        if (
+            not node
+            or node == self.node
+            or self.rpc is None
+            or node not in self.bus.peers
+        ):
+            return None
+        return RemotePartyHandler(self, party_id, node)
+
+    # --------------------------------------------- cross-node primitives
+
+    def untrack_presence(self, presence, stream) -> None:
+        """Untrack a member wherever its session lives: locally via the
+        tracker (replicates out as usual), remotely via one `pt.untrack`
+        frame to the owning node — whose LOCAL untrack then replicates
+        the leave back to everyone, authority included."""
+        node = presence.id.node
+        if not node or node == self.node or self.bus is None:
+            self.tracker.untrack(presence.id.session_id, stream)
+            return
+        try:
+            self.bus.send(
+                node,
+                "pt.untrack",
+                {
+                    "sid": presence.id.session_id,
+                    "st": _stream_to_wire(stream),
+                },
+            )
+        except Exception:
+            # Best-effort: a lost untrack is healed by the member's own
+            # leave/disconnect or the node-death sweep.
+            pass
+
+    def _on_untrack(self, src: str, d: dict) -> None:
+        self.tracker.untrack(d.get("sid", ""), _stream_from_wire(d["st"]))
+
+    def adopt(self, handler, presence) -> bool:
+        """Track an accepted member into the party on the node that
+        owns its session, and hand it the party envelope. Local
+        sessions adopt inline (track + synchronous on_joins, the same
+        order the pipeline's local accept uses); remote ones get a
+        `pt.adopt` frame and membership converges via replication."""
+        node = presence.id.node
+        if not node or node == self.node:
+            session = (
+                self.session_registry.get(presence.id.session_id)
+                if self.session_registry is not None
+                else None
+            )
+            if session is None:
+                raise PartyError("accepted session gone")
+            self._leave_other_parties(
+                presence.id.session_id, handler.party_id
+            )
+            self.tracker.track(
+                presence.id.session_id,
+                handler.stream,
+                presence.user_id,
+                presence.meta,
+            )
+            handler.on_joins([presence])
+            session.send(
+                {"party": {**handler.as_dict(),
+                           "self": presence.as_dict()}}
+            )
+            return True
+        if self.bus is None:
+            raise PartyError("accepted session gone")
+        # Pre-register like the local path (synchronous membership at
+        # the authority; the adoptee's replicated track re-delivers
+        # idempotently, a dead adoptee node is swept by sweep_node).
+        handler.on_joins([presence])
+        try:
+            self.bus.send(
+                node,
+                "pt.adopt",
+                {
+                    "sid": presence.id.session_id,
+                    "uid": presence.user_id,
+                    "st": _stream_to_wire(handler.stream),
+                    "p": _presence_to_wire(presence),
+                    "party": handler.as_dict(),
+                },
+            )
+        except Exception:
+            # Lost adopt: the member never tracks, and sweep_node /
+            # a leader remove reclaims the pre-registered seat.
+            pass
+        return True
+
+    def _on_adopt(self, src: str, d: dict) -> None:
+        sid = d.get("sid", "")
+        session = (
+            self.session_registry.get(sid)
+            if self.session_registry is not None
+            else None
+        )
+        if session is None:
+            # Session vanished between accept and adopt: nothing was
+            # tracked anywhere, so the party never gains the member —
+            # the request was already consumed, the seat frees up.
+            return
+        stream = _stream_from_wire(d["st"])
+        self._leave_other_parties(sid, stream.subject)
+        p = _presence_from_wire(self.node, d["p"])
+        self.tracker.track(sid, stream, d.get("uid", ""), p.meta)
+        session.send(
+            {"party": {**(d.get("party") or {}), "self": p.as_dict()}}
+        )
+
+    def sweep_node(self, node: str) -> int:
+        """Peer death: drop its members from every local party. The
+        tracker's presence sweep already fires leave events for TRACKED
+        members (this is then idempotent); what this additionally
+        covers is the pre-registered member whose node died between
+        the join RPC and its local track — a zombie no leave event
+        would ever reach."""
+        swept = 0
+        for handler in list(self._parties.values()):
+            leaves = [
+                p
+                for p in handler.members.values()
+                if p.id.node == node
+            ]
+            if leaves:
+                swept += len(leaves)
+                handler.on_leaves(leaves)
+        if swept:
+            self.logger.warn(
+                "swept party members of dead node",
+                node=node, count=swept,
+            )
+        return swept
+
+    def _leave_other_parties(self, session_id: str, joining_id: str):
+        """session.single_party across nodes: adopting into a party
+        leaves any other one this session is in (mirrors the pipeline's
+        local-path semantics)."""
+        if self.config is None or not self.config.session.single_party:
+            return
+        for stream in list(self.tracker.get_local_by_session(session_id)):
+            if (
+                stream.mode == StreamMode.PARTY
+                and stream.subject != joining_id
+            ):
+                self.tracker.untrack(session_id, stream)
+
+    # ------------------------------------------------- authority handler
+
+    def _on_party_op(self, src: str, d: dict) -> dict:
+        handler = self._parties.get(d.get("pid", ""))
+        if handler is None:
+            raise ClusterOpError("party not found", "not_found")
+        op = d.get("op", "")
+        sid = d.get("sid", "")
+        if op == "join":
+            p = _presence_from_wire(src, d["presence"])
+            allowed = handler.request_join(p)
+            if allowed:
+                # Membership applies at the authority SYNCHRONOUSLY
+                # (the joiner's replicated track event re-delivers it
+                # idempotently): a leader that matchmakes right after
+                # the join ack must see the member in the ticket —
+                # waiting for replication would race every party-then-
+                # matchmake flow. A joiner node that dies before
+                # tracking is cleaned by `sweep_node`.
+                handler.on_joins([p])
+            return {"allowed": allowed, "party": handler.as_dict()}
+        if op == "accept":
+            p = handler.accept(sid, d.get("presence") or {})
+            self.adopt(handler, p)
+            return {"party": handler.as_dict()}
+        if op == "remove":
+            removed = handler.remove(sid, d.get("presence") or {})
+            if removed is not None:
+                self.untrack_presence(removed, handler.stream)
+            return {}
+        if op == "promote":
+            handler.promote(sid, d.get("presence") or {})
+            return {}
+        if op == "list_requests":
+            pending = handler.join_request_list(sid)
+            return {"presences": [p.as_dict() for p in pending]}
+        if op == "close":
+            handler.close(sid, self.tracker)
+            self.remove(handler.party_id)
+            return {}
+        if op == "data":
+            handler.data_send(
+                sid, int(d.get("op_code", 0)), d.get("data", "")
+            )
+            return {}
+        if op == "mm_add":
+            ticket = handler.matchmaker_add(
+                sid,
+                d.get("query") or "*",
+                int(d.get("min_count", 0)),
+                int(d.get("max_count", 0)),
+                int(d.get("count_multiple", 1) or 1),
+                d.get("sp") or {},
+                d.get("np") or {},
+            )
+            return {"ticket": ticket}
+        if op == "mm_remove":
+            handler.matchmaker_remove(sid, d.get("ticket", ""))
+            return {}
+        raise ClusterOpError(f"unknown party op {op!r}", "not_found")
+
+
+# ---------------------------------------------------------------- match
+
+
+class ClusterMatchRegistry(LocalMatchRegistry):
+    """LocalMatchRegistry + cross-node authoritative join and data.
+
+    A join attempt for a foreign match id runs the admission RPC at the
+    authority (`match.join` — the core's match_join_attempt executes on
+    its own task there); on allow, the joiner tracks locally and the
+    replicated presence event feeds the authority's join listener.
+    Data sends forward as one fire-and-forget `mt.data` frame into the
+    handler's bounded input queue — loss costs a message (the relayed
+    posture), never a wedged match loop."""
+
+    def __init__(self, logger: Logger, config, router,
+                 node: str = "local", metrics=None, tracker=None,
+                 bus=None, rpc: BusRpc | None = None):
+        super().__init__(
+            logger, config, router, node, metrics, tracker
+        )
+        self.bus = bus
+        self.rpc = rpc
+        if rpc is not None:
+            rpc.register("match.join", self._on_join_rpc)
+        if bus is not None:
+            bus.on("mt.data", self._on_data)
+
+    def remote_node_of(self, match_id: str) -> str | None:
+        """The authority peer for a foreign match id; None when the id
+        is local, carries no node, or names an unknown peer (relayed
+        matches on this node fall through to the relayed path)."""
+        node = owner_node_of(match_id)
+        if (
+            not node
+            or node == self.node
+            or self.bus is None
+            or node not in self.bus.peers
+        ):
+            return None
+        return node
+
+    async def join_attempt_remote(
+        self, match_id: str, presence, metadata: dict | None = None
+    ) -> dict:
+        """Run the join admission at the authority. Returns
+        ``{found, allow, reason, label, presences}``; `found` False
+        means no authoritative match by that id lives there (the caller
+        falls back to the relayed path, exactly like a local miss)."""
+        node = self.remote_node_of(match_id)
+        if node is None:
+            return {"found": False}
+        try:
+            return await self.rpc.call(
+                node,
+                "match.join",
+                {
+                    "mid": match_id,
+                    "p": _presence_to_wire(presence),
+                    "md": metadata or {},
+                },
+            )
+        except ClusterOpError as e:
+            raise MatchError(str(e)) from e
+
+    async def _on_join_rpc(self, src: str, d: dict) -> dict:
+        handler = self._handlers.get(d.get("mid", ""))
+        if handler is None:
+            return {"found": False}
+        presence = _presence_from_wire(src, d["p"])
+        allow, reason = await handler.join_attempt(
+            presence, d.get("md") or {}
+        )
+        return {
+            "found": True,
+            "allow": bool(allow),
+            "reason": reason or "",
+            "label": handler.label,
+            "presences": [p.as_dict() for p in handler.presences.list()],
+        }
+
+    def send_data(self, match_id: str, sender, op_code: int,
+                  data: bytes, reliable: bool = True) -> bool:
+        if match_id in self._handlers:
+            return super().send_data(
+                match_id, sender, op_code, data, reliable
+            )
+        node = self.remote_node_of(match_id)
+        if node is None:
+            return False
+        try:
+            return self.bus.send(
+                node,
+                "mt.data",
+                {
+                    "mid": match_id,
+                    "p": _presence_to_wire(sender),
+                    "op": int(op_code),
+                    "data": base64.b64encode(bytes(data)).decode(
+                        "ascii"
+                    ),
+                    "r": bool(reliable),
+                },
+            )
+        except Exception:
+            return False  # costs the message, like the relayed path
+
+    def _on_data(self, src: str, d: dict) -> None:
+        handler = self._handlers.get(d.get("mid", ""))
+        if handler is None:
+            return
+        sender = _presence_from_wire(src, d["p"])
+        handler.queue_data(
+            MatchMessage(
+                sender=sender,
+                op_code=int(d.get("op", 0)),
+                data=base64.b64decode(d.get("data", "") or b""),
+                reliable=bool(d.get("r", True)),
+                receive_time_ms=int(time.time() * 1000),
+            )
+        )

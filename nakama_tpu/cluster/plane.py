@@ -96,6 +96,11 @@ class ClusterPlane:
         self.monitor: FailoverMonitor | None = None
         self._matchmaker = None
         self._ingest = None
+        self._recovery = None
+        # A demoted (superseded) owner re-subordinates as the NEW
+        # owner's warm standby: this holds the node it now shadows
+        # (announced over heartbeats exactly like a configured standby).
+        self.resub_standby_of: str = ""
         self.membership.payload_hook = self._hb_payload
         self.membership.on_heartbeat.append(self._fold_hb)
 
@@ -118,12 +123,16 @@ class ClusterPlane:
         out: dict = {}
         if self.lease is not None:
             out.update(self.lease.heartbeat_payload())
-        if self.is_standby and not (
-            self.monitor is not None and self.monitor.promoted
-        ):
+        promoted = self.monitor is not None and self.monitor.promoted
+        if self.is_standby and not promoted:
             # Announce the shadow relationship: the owner's shipper
             # discovers its standby from this, no owner-side config.
             out["standby_of"] = self.config.cluster.standby_of
+        elif self.resub_standby_of and not promoted:
+            # Demoted owner re-subordinated as the new owner's warm
+            # standby (same announcement path; a later promote-back
+            # stops it exactly like a configured standby's does).
+            out["standby_of"] = self.resub_standby_of
         self.directory.publish_gauges()
         if self.shipper is not None:
             self.shipper.publish_gauges()
@@ -185,6 +194,7 @@ class ClusterPlane:
         cc = self.config.cluster
         self._matchmaker = matchmaker
         self._ingest = ingest
+        self._recovery = recovery
         if self.is_owner:
             # An owner claims the shard named after itself (shard ids
             # ARE the configured owner-fleet node names; the degenerate
@@ -219,7 +229,7 @@ class ClusterPlane:
                     metrics=self.metrics,
                 )
         elif self.is_standby:
-            from .replication import ReplicationApplier
+            from .replication import JournalShipper, ReplicationApplier
 
             shard = cc.standby_of
             self.applier = ReplicationApplier(
@@ -230,6 +240,21 @@ class ClusterPlane:
                 self.logger,
                 metrics=self.metrics,
             )
+            # A standby carries a (dormant) shipper too: after it
+            # promotes, the demoted old owner re-subordinates and
+            # announces `standby_of` — the promoted owner must be able
+            # to stream its journal tail to that fresh standby, closing
+            # the failover circle (no-standby hook = one None check).
+            journal = getattr(recovery, "journal", None)
+            if journal is not None:
+                self.shipper = JournalShipper(
+                    journal,
+                    matchmaker,
+                    self.bus,
+                    self.node,
+                    self.logger,
+                    metrics=self.metrics,
+                )
             # The standby's lease manager owns nothing until promotion.
             self.lease = LeaseManager(
                 self.directory, self.node, [], self.logger,
@@ -254,17 +279,65 @@ class ClusterPlane:
         """A higher epoch replaced us (we were partitioned through a
         takeover): stop forming matches — frontends already route by
         the new epoch, and the directory refuses our stale renewals
-        everywhere. Restart/operator intervention turns this node into
-        a standby replacement; automatic re-subordination is future
-        work (README documents the posture)."""
+        everywhere — then RE-SUBORDINATE as the new owner's warm
+        standby: announce `standby_of` over heartbeats and attach a
+        fresh ReplicationApplier shadowing the new epoch's owner. The
+        applier boots in `need_sync` posture, so its first act is a
+        full snapshot request that rebuilds this pool from the new
+        owner's truth (our tenure's divergence is discarded, exactly
+        like a configured standby's cold attach). A fresh
+        FailoverMonitor arms the promote-back path, closing the
+        failover circle without an operator restart."""
         if self._matchmaker is not None:
             try:
                 self._matchmaker.pause()
             except Exception:
                 pass
+        if self.applier is not None:
+            # A previously-attached applier (re-demotion) must stop
+            # before the new one claims the repl.* handlers.
+            self.applier.detach()
+        if self.shipper is not None:
+            # We are no longer an owner: stop streaming our journal —
+            # the promoted owner's applier detached at promotion and
+            # our rows are now its applied stream echoed back.
+            self.shipper.set_standby(None)
+        from .replication import ReplicationApplier
+
+        self.resub_standby_of = new_owner
+        self.applier = ReplicationApplier(
+            self._matchmaker,
+            self.bus,
+            new_owner,
+            self.node,
+            self.logger,
+            metrics=self.metrics,
+        )
+        if self.monitor is not None:
+            self.monitor.stop()
+        self.monitor = FailoverMonitor(
+            self.directory,
+            self.lease,
+            shard,
+            self.node,
+            self.logger,
+            matchmaker=self._matchmaker,
+            applier=self.applier,
+            recovery=self._recovery,
+            membership=self.membership,
+            metrics=self.metrics,
+            heartbeat_s=self.membership.heartbeat_s,
+        )
+        try:
+            self.monitor.start()
+        except RuntimeError:
+            # No running loop (unit-test construction): the monitor is
+            # armed but unscheduled; start_failover can start it later.
+            pass
         self.logger.warn(
-            "this node was superseded as shard owner — matchmaking"
-            " paused (demoted posture)",
+            "this node was superseded as shard owner — re-subordinated"
+            " as the new owner's warm standby (shadow pool re-syncing;"
+            " promote-back armed)",
             shard=shard, new_owner=new_owner, epoch=epoch,
         )
 

@@ -107,6 +107,19 @@ class ClusterTracker(LocalTracker):
 
     # ------------------------------------------------ local + replicate
 
+    def _replicate(self, ftype: str, body: dict) -> None:
+        """Best-effort presence replication. Frames are fire-and-forget
+        by DESIGN; a raise-mode `cluster.send` fault (or a bus mid-
+        teardown) must cost the FRAME — a stale remote view healed by
+        the next pr.sync — never turn the LOCAL presence write above it
+        into an internal error. (Found by the PR 12 soak rig: an armed
+        send fault was failing status updates and channel joins whose
+        local work had already succeeded.)"""
+        try:
+            self.bus.broadcast(ftype, body)
+        except Exception:
+            self._repl_dropped = getattr(self, "_repl_dropped", 0) + 1
+
     def track(self, session_id, stream, user_id, meta,
               allow_if_first_for_session=False):
         ok, newly = super().track(
@@ -115,14 +128,14 @@ class ClusterTracker(LocalTracker):
         if ok and newly and self.bus is not None:
             p = self._by_session.get(session_id, {}).get(stream)
             if p is not None:
-                self.bus.broadcast("pr.track", _presence_to_wire(p))
+                self._replicate("pr.track", _presence_to_wire(p))
         return ok, newly
 
     def untrack(self, session_id, stream):
         existed = stream in self._by_session.get(session_id, {})
         super().untrack(session_id, stream)
         if existed and self.bus is not None:
-            self.bus.broadcast(
+            self._replicate(
                 "pr.untrack",
                 {"sid": session_id, "st": _stream_to_wire(stream)},
             )
@@ -131,7 +144,7 @@ class ClusterTracker(LocalTracker):
         existed = bool(self._by_session.get(session_id))
         super().untrack_all(session_id, reason)
         if existed and self.bus is not None:
-            self.bus.broadcast("pr.untrack_all", {"sid": session_id})
+            self._replicate("pr.untrack_all", {"sid": session_id})
 
     def update(self, session_id, stream, user_id, meta):
         existed = stream in self._by_session.get(session_id, {})
@@ -142,7 +155,7 @@ class ClusterTracker(LocalTracker):
             # override already broadcast.
             p = self._by_session.get(session_id, {}).get(stream)
             if p is not None:
-                self.bus.broadcast("pr.track", _presence_to_wire(p))
+                self._replicate("pr.track", _presence_to_wire(p))
         return ok
 
     # -------------------------------------------------- remote handlers
@@ -352,10 +365,14 @@ class ClusterSessionRegistry(LocalSessionRegistry):
         if self.bus is not None:
             # Not local: ask every peer (ids are unique; at most one
             # node holds it). Best-effort — a down peer's sessions are
-            # already gone.
-            self.bus.broadcast(
-                "sess.disconnect", {"sid": session_id, "reason": reason}
-            )
+            # already gone, and a send fault costs the request only.
+            try:
+                self.bus.broadcast(
+                    "sess.disconnect",
+                    {"sid": session_id, "reason": reason},
+                )
+            except Exception:
+                pass
         return False
 
     def _on_disconnect(self, src: str, d: dict):

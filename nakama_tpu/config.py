@@ -429,6 +429,35 @@ class RecoveryConfig:
 
 
 @dataclass
+class LoadgenConfig:
+    """In-process soak/load engine (loadgen/): an open-loop,
+    scenario-catalog session population driven against this node's own
+    pipeline — the modeled tier of the two-tier soak model (real
+    websocket clients are driven by the lab parent, bench.py --soak).
+    Off by default; production nodes never run it."""
+
+    enabled: bool = False
+    # Target steady-state concurrent modeled sessions on this node.
+    sessions: int = 100
+    # Poisson arrival rate; 0 derives it from sessions / lifetime_mean
+    # (Little's law), so the population hovers at the target.
+    arrival_rate_per_s: float = 0.0
+    # Lognormal session lifetimes (mean seconds + shape sigma).
+    lifetime_mean_s: float = 20.0
+    lifetime_sigma: float = 0.8
+    # Arrival/lifetime/mix stream seed — one seed reproduces the whole
+    # schedule bit-for-bit.
+    seed: int = 1
+    # Scenario mix as name=weight entries; empty = the default catalog
+    # mix (loadgen/engine.py DEFAULT_MIX).
+    mix: list[str] = field(default_factory=list)
+    # Hard protective cap on concurrent modeled sessions; 0 = 2x the
+    # target. Capped arrivals are COUNTED (loadgen_sessions{state=
+    # "shed"}), never silently dropped — open-loop honesty.
+    max_concurrent: int = 0
+
+
+@dataclass
 class SocialConfig:
     steam_app_id: int = 0
     steam_publisher_key: str = ""
@@ -530,6 +559,7 @@ class Config:
     recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
     devobs: DevObsConfig = field(default_factory=DevObsConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    loadgen: LoadgenConfig = field(default_factory=LoadgenConfig)
 
     @property
     def node(self) -> str:
@@ -696,6 +726,32 @@ class Config:
             warnings.append("tracing.slo_target should be in (0, 1)")
         if self.devobs.warmup_intervals < 0:
             raise ValueError("devobs.warmup_intervals must be >= 0")
+        lg = self.loadgen
+        if lg.enabled:
+            if lg.sessions < 1:
+                raise ValueError("loadgen.sessions must be >= 1")
+            if lg.lifetime_mean_s <= 0 or lg.lifetime_sigma <= 0:
+                raise ValueError(
+                    "loadgen.lifetime_mean_s and loadgen.lifetime_sigma"
+                    " must be > 0"
+                )
+            if lg.arrival_rate_per_s < 0:
+                raise ValueError(
+                    "loadgen.arrival_rate_per_s must be >= 0"
+                )
+            for spec in lg.mix:
+                name = str(spec).partition("=")[0].strip()
+                from .loadgen.scenarios import CATALOG as _CATALOG
+
+                if name not in _CATALOG:
+                    raise ValueError(
+                        f"loadgen.mix names unknown scenario {name!r}"
+                        f" (catalog: {sorted(_CATALOG)})"
+                    )
+            warnings.append(
+                "loadgen.enabled — this node generates synthetic load"
+                " against itself (soak lab posture, not production)"
+            )
         if self.devobs.capture_max_ms > 60_000:
             warnings.append(
                 "devobs.capture_max_ms over 60s — a console-triggered"

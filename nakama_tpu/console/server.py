@@ -91,6 +91,7 @@ class ConsoleServer:
         r.add_get("/v2/console/match", self._h_match_list)
         r.add_get("/v2/console/matchmaker", self._h_matchmaker)
         r.add_get("/v2/console/cluster", self._h_cluster)
+        r.add_get("/v2/console/soak", self._h_soak)
         r.add_get("/v2/console/device", self._h_device)
         r.add_post("/v2/console/device/capture", self._h_device_capture)
         self._capture_busy = False
@@ -811,6 +812,24 @@ class ConsoleServer:
                     else 0
                 ),
                 "matchmaker_tickets": len(mm),
+            }
+        )
+
+    async def _h_soak(self, request: web.Request):
+        """Live soak posture (loadgen/): the open-loop session
+        population counters and the per-scenario SLO table the judge
+        gates on — the node's slice of the fleet verdict `bench.py
+        --soak` merges."""
+        self._auth(request)
+        engine = getattr(self.server, "soak_engine", None)
+        if engine is None:
+            return web.json_response({"enabled": False})
+        engine.judge.sample()
+        return web.json_response(
+            {
+                "enabled": True,
+                "sessions": engine.stats(),
+                "slo_table": engine.judge.table(),
             }
         )
 

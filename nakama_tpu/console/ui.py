@@ -93,6 +93,7 @@ const R = {
   matchState:       ['GET',    '/v2/console/match/{id}/state'],
   matchmaker:       ['GET',    '/v2/console/matchmaker'],
   cluster:          ['GET',    '/v2/console/cluster'],
+  soak:             ['GET',    '/v2/console/soak'],
   device:           ['GET',    '/v2/console/device'],
   deviceCapture:    ['POST',   '/v2/console/device/capture'],
   lbList:           ['GET',    '/v2/console/leaderboard'],
@@ -553,6 +554,22 @@ const TABS = {
     // breaker state, local/remote presence split.
     const d = await call('cluster');
     el.appendChild($(jpre(d)));
+  },
+  soak: async (el) => {
+    // Soak posture: open-loop session population + the live
+    // per-scenario SLO table the soak judge gates on.
+    const d = await call('soak');
+    if (!d.enabled) { el.appendChild($(jpre(d))); return; }
+    const rows = Object.entries(d.slo_table || {}).map(([n, r]) =>
+      `<tr><td>${esc(n)}</td><td>${esc(r.ops)}</td>
+       <td>${esc(r.availability)}</td><td>${esc(r.p99_ms)}</td>
+       <td>${esc(r.burn_5m)}</td><td>${esc(r.burn_1h)}</td>
+       <td>${esc(r.internal_errors)}</td></tr>`).join('');
+    el.appendChild($(`<h4>sessions</h4>${jpre(d.sessions || {})}
+      <h4>per-scenario SLO table</h4>
+      <table><tr><th>scenario</th><th>ops</th><th>avail</th>
+      <th>p99ms</th><th>burn5m</th><th>burn1h</th><th>interr</th>
+      </tr>${rows}</table>`));
   },
   device: async (el) => {
     // Device telemetry: kernel clocks + compile-watch, HBM ledger by

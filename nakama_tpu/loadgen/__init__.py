@@ -1,0 +1,48 @@
+"""Million-session soak plane: scenario-catalog load rig + SLO judge.
+
+`scenarios.py` is the catalog (composable session scripts as async
+state machines over the whole reference workload surface), `engine.py`
+the open-loop two-tier population model (modeled in-process sessions
+at scale + real websocket wire truth, never conflated), and `judge.py`
+the per-scenario SLO table with the named `soak_slo_regression` gate
+`bench.py --soak` folds into the `bench_all_metrics` tail + rc."""
+
+from .engine import (
+    DEFAULT_MIX,
+    ArrivalModel,
+    ModeledContext,
+    RealSession,
+    SoakEngine,
+    parse_mix,
+    run_real_catalog,
+)
+from .judge import (
+    DEFAULT_SLOS,
+    SoakJudge,
+    merge_tables,
+    soak_slo_regression,
+)
+from .scenarios import (
+    CATALOG,
+    ECHO_MATCH_NAME,
+    SOAK_TOURNAMENT_ID,
+    EchoMatchCore,
+)
+
+__all__ = [
+    "ArrivalModel",
+    "CATALOG",
+    "DEFAULT_MIX",
+    "DEFAULT_SLOS",
+    "ECHO_MATCH_NAME",
+    "EchoMatchCore",
+    "ModeledContext",
+    "RealSession",
+    "SOAK_TOURNAMENT_ID",
+    "SoakEngine",
+    "SoakJudge",
+    "merge_tables",
+    "parse_mix",
+    "run_real_catalog",
+    "soak_slo_regression",
+]

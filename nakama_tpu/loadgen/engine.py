@@ -1,0 +1,758 @@
+"""Open-loop session engine: the load rig's population model.
+
+Two tiers, explicitly accounted (never conflated — every op record
+carries its tier):
+
+- **modeled** — in-process sessions driving the node's OWN pipeline
+  (`Pipeline.process` with a registered minimal session object), so
+  admission, deadlines, matchmaker fan-in, storage group commits and
+  cross-node routing all run exactly as for a socket session, without
+  paying one OS socket per user. This is the 100k–1M tier.
+- **real** — live websocket clients (aiohttp `/ws`) driven by the lab
+  parent across DIFFERENT frontend nodes: the wire-truth core that
+  proves framing, auth, and the cross-node paths end-to-end.
+
+Arrivals are open-loop (`ArrivalModel`): Poisson inter-arrival gaps at
+a configured rate (or derived from the target population by Little's
+law), lognormal session lifetimes, weighted scenario mix — all from
+one seed, so a schedule is reproducible bit-for-bit (the determinism
+unit test pins this). Open-loop means arrivals never wait for
+completions: overload shows up as latency/burn in the judge table,
+not as a self-throttling rig. The one protective bound — a hard cap on
+concurrent modeled sessions — is EXPLICIT: capped arrivals are counted
+and published as `loadgen_sessions{state="shed"}`, never silently
+dropped."""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+import time
+import uuid
+from collections import deque
+
+from ..logger import Logger
+from .judge import SoakJudge
+from .scenarios import (
+    CATALOG,
+    ECHO_MATCH_NAME,
+    OP_TIMEOUT_S,
+    SOAK_TOURNAMENT_ID,
+    EchoMatchCore,
+)
+
+DEFAULT_MIX = {
+    "matchmake_solo": 2.0,
+    "party_matchmake": 1.0,
+    "match_relay": 1.0,
+    "chat_fanout": 3.0,
+    "status_churn": 3.0,
+    "storage_occ": 2.0,
+    "tournament_flow": 1.0,
+}
+
+
+def parse_mix(specs) -> dict[str, float]:
+    """``name=weight`` config entries -> mix dict (empty = default)."""
+    out: dict[str, float] = {}
+    for spec in specs or ():
+        name, _, w = str(spec).partition("=")
+        name = name.strip()
+        if name in CATALOG:
+            try:
+                out[name] = max(0.0, float(w or 1.0))
+            except ValueError:
+                continue
+    return out or dict(DEFAULT_MIX)
+
+
+class ArrivalModel:
+    """Seeded open-loop arrival/churn model. `next_arrival()` consumes
+    the stream; `schedule(horizon_s)` derives the same stream purely
+    from the seed (bit-for-bit reproducible, independent of any
+    next_arrival() calls already made)."""
+
+    def __init__(self, rate_per_s: float, lifetime_mean_s: float,
+                 lifetime_sigma: float, mix: dict[str, float],
+                 seed: int = 1):
+        self.rate = max(1e-6, float(rate_per_s))
+        self.lifetime_mean_s = max(0.1, float(lifetime_mean_s))
+        self.sigma = max(0.01, float(lifetime_sigma))
+        # Lognormal with the configured MEAN (not median):
+        # mean = exp(mu + sigma^2/2)  =>  mu = ln(mean) - sigma^2/2.
+        self.mu = math.log(self.lifetime_mean_s) - self.sigma**2 / 2.0
+        mix = {k: v for k, v in mix.items() if v > 0} or dict(DEFAULT_MIX)
+        self.names = sorted(mix)
+        self.weights = [mix[k] for k in self.names]
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+
+    def _next(self, rng) -> tuple[float, float, str]:
+        gap = rng.expovariate(self.rate)
+        life = rng.lognormvariate(self.mu, self.sigma)
+        scen = rng.choices(self.names, weights=self.weights, k=1)[0]
+        return gap, life, scen
+
+    def next_arrival(self) -> tuple[float, float, str]:
+        """(gap_s to the next arrival, its lifetime_s, its scenario)."""
+        return self._next(self._rng)
+
+    def schedule(self, horizon_s: float) -> list[tuple[float, float, str]]:
+        """The arrival schedule over [0, horizon_s): (t, lifetime,
+        scenario) rows, derived fresh from the seed."""
+        rng = random.Random(self.seed)
+        out, t = [], 0.0
+        while True:
+            gap, life, scen = self._next(rng)
+            t += gap
+            if t >= horizon_s:
+                return out
+            out.append((round(t, 6), round(life, 6), scen))
+
+
+# ------------------------------------------------------------ op records
+
+
+def classify_error_envelope(env: dict) -> str:
+    """error envelope -> outcome. The soak gate requires ZERO
+    `internal_error` outcomes: a handler escape is a product bug, a
+    typed refusal (overload, unavailable owner, domain error) is
+    degradation the SLOs price in."""
+    msg = (env.get("error") or {}).get("message", "")
+    return "internal_error" if msg == "internal error" else "error"
+
+
+class _BaseContext:
+    """Shared step/record surface both tiers implement over their own
+    transport. `scenario` is (re)bound by the episode runner."""
+
+    tier = "modeled"
+
+    def __init__(self, judge: SoakJudge, node: str, seq: int):
+        self.judge = judge
+        self.node = node
+        self.seq = seq
+        self.scenario = "unassigned"
+        self._cid = 0
+        self._key_seq = 0
+
+    def unique_key(self) -> str:
+        self._key_seq += 1
+        return f"{self.node}x{self.seq}x{self._key_seq}"
+
+    def record(self, op: str, outcome: str,
+               latency_ms: float = 0.0) -> None:
+        self.judge.observe(
+            self.scenario, op, outcome, latency_ms, self.tier
+        )
+
+    def _next_cid(self) -> str:
+        self._cid += 1
+        return f"lg{self.seq}c{self._cid}"
+
+
+class _ModeledSession:
+    """The minimal Session surface the realtime layer needs, with an
+    inbox + wakeup event instead of a socket."""
+
+    def __init__(self, session_id: str, user_id: str, username: str):
+        self.id = session_id
+        self.user_id = user_id
+        self.username = username
+        self.format = "json"
+        self.inbox: deque = deque(maxlen=512)
+        self.event = asyncio.Event()
+        self.closed = False
+
+    def send(self, envelope: dict) -> bool:
+        if self.closed:
+            return False
+        self.inbox.append(envelope)
+        self.event.set()
+        return True
+
+    async def close(self, reason: str = "", **kw):
+        self.closed = True
+
+
+class ModeledContext(_BaseContext):
+    """One modeled session: authenticated against the node's real user
+    store, registered in the session registry (matched envelopes and
+    routed frames deliver to it exactly like a socket session), driven
+    through `Pipeline.process`."""
+
+    tier = "modeled"
+
+    def __init__(self, server, judge, seq: int):
+        super().__init__(judge, server.config.name, seq)
+        self.server = server
+        self.sess: _ModeledSession | None = None
+
+    async def open(self) -> "ModeledContext":
+        from ..core.authenticate import authenticate_device
+
+        device_id = f"soak-{self.node}-{self.seq:010d}"
+        user_id, username, _ = await authenticate_device(
+            self.server.db, device_id, f"lg-{self.node}-{self.seq}", True
+        )
+        self.user_id = user_id
+        self.sess = _ModeledSession(
+            f"lg{uuid.uuid4().hex[:12]}", user_id, username
+        )
+        self.server.session_registry.add(self.sess)
+        return self
+
+    # ------------------------------------------------------------- steps
+
+    def _scan_cid(self, cid: str, reply_key: str | None):
+        """One pass over the inbox for this cid's reply (or error)."""
+        for env in list(self.sess.inbox):
+            if env.get("cid") != cid:
+                continue
+            self.sess.inbox.remove(env)
+            if "error" in env:
+                return env, classify_error_envelope(env)
+            if reply_key is None or reply_key == "cid" or reply_key in env:
+                return env, "ok"
+        return None, None
+
+    async def step(self, op: str, envelope: dict,
+                   reply_key: str | None,
+                   timeout: float = OP_TIMEOUT_S):
+        cid = self._next_cid()
+        env = dict(envelope)
+        env["cid"] = cid
+        t0 = time.perf_counter()
+        try:
+            await asyncio.wait_for(
+                self.server.pipeline.process(self.sess, env), timeout
+            )
+        except asyncio.TimeoutError:
+            self.record(op, "timeout", (time.perf_counter() - t0) * 1e3)
+            return None
+        except Exception:
+            # The pipeline answers its own errors; an ESCAPE here is a
+            # product bug — exactly what the gate's zero-internal-error
+            # clause exists to catch.
+            self.record(
+                op, "internal_error", (time.perf_counter() - t0) * 1e3
+            )
+            return None
+        ms = (time.perf_counter() - t0) * 1e3
+        reply, outcome = self._scan_cid(cid, reply_key)
+        if outcome is None:
+            # Fire-and-forget op (no reply contract): process returned
+            # without an error envelope.
+            if reply_key is None:
+                self.record(op, "ok", ms)
+                return {}
+            self.record(op, "timeout", ms)
+            return None
+        self.record(op, outcome, ms)
+        return reply if outcome == "ok" else None
+
+    async def step_wait(self, op: str, key: str, timeout: float):
+        t0 = time.perf_counter()
+        t_end = t0 + timeout
+        while True:
+            for env in list(self.sess.inbox):
+                if key in env:
+                    self.sess.inbox.remove(env)
+                    self.record(
+                        op, "ok", (time.perf_counter() - t0) * 1e3
+                    )
+                    return env
+            rem = t_end - time.perf_counter()
+            if rem <= 0:
+                self.record(op, "timeout", timeout * 1e3)
+                return None
+            self.sess.event.clear()
+            try:
+                await asyncio.wait_for(
+                    self.sess.event.wait(), min(rem, 0.5)
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    # ----------------------------------------------------- core surfaces
+
+    async def storage_write(self, collection: str, key: str, value: str,
+                            version: str) -> tuple[bool, str]:
+        from ..core.storage import (
+            StorageError,
+            StorageOpWrite,
+            storage_write_objects,
+        )
+
+        try:
+            acks = await storage_write_objects(
+                self.server.db,
+                self.user_id,
+                [
+                    StorageOpWrite(
+                        collection=collection,
+                        key=key,
+                        user_id=self.user_id,
+                        value=value,
+                        version=version,
+                    )
+                ],
+            )
+            return True, acks[0].version if acks else ""
+        except StorageError:
+            return False, ""
+        except Exception:
+            return False, ""
+
+    async def tournament_join(self, tid: str) -> bool:
+        try:
+            await self.server.tournaments.join(
+                tid, self.user_id, self.sess.username
+            )
+            return True
+        except Exception:
+            return False
+
+    async def tournament_write(self, tid: str, score: int) -> bool:
+        try:
+            await self.server.tournaments.record_write(
+                tid, self.user_id, self.sess.username, int(score)
+            )
+            return True
+        except Exception:
+            return False
+
+    async def tournament_rank(self, tid: str) -> bool:
+        try:
+            await self.server.tournaments.records_list(tid, limit=5)
+            return True
+        except Exception:
+            return False
+
+    # ------------------------------------------------------------- close
+
+    async def close(self):
+        if self.sess is None:
+            return
+        self.sess.closed = True
+        sid = self.sess.id
+        server = self.server
+        try:
+            remove_all = getattr(
+                server.matchmaker, "remove_session_all", None
+            )
+            if remove_all is not None:
+                remove_all(sid)
+        except Exception:
+            pass
+        try:
+            server.tracker.untrack_all(sid)
+        except Exception:
+            pass
+        try:
+            server.status_registry.unfollow_all(sid)
+        except Exception:
+            pass
+        server.session_registry.remove(sid)
+
+
+class RealSession(_BaseContext):
+    """One real websocket session (aiohttp) — the wire-truth tier. The
+    lab parent opens these against DIFFERENT frontend nodes and hands
+    them to the catalog, so every scenario's cross-node path runs over
+    actual sockets. Core-surface ops ride the REST API with the session
+    bearer token."""
+
+    tier = "real"
+
+    def __init__(self, judge, node: str, seq: int, http, base: str):
+        super().__init__(judge, node, seq)
+        self.http = http
+        self.base = base
+        self.ws = None
+        self.token = ""
+        self.inbox: deque = deque(maxlen=512)
+        self.acked_tickets: list[str] = []
+        self.matched_tickets: list[str] = []
+
+    async def open(self, device_id: str) -> "RealSession":
+        import base64 as _b64
+
+        auth = "Basic " + _b64.b64encode(b"defaultkey:").decode()
+        async with self.http.post(
+            f"{self.base}/v2/account/authenticate/device",
+            json={"account": {"id": device_id}, "username": f"rl{self.seq}"},
+            headers={"Authorization": auth},
+        ) as r:
+            assert r.status == 200, (r.status, await r.text())
+            self.token = (await r.json())["token"]
+        # Scenarios reference ctx.user_id (status follow targets) on
+        # both tiers; resolve it once off the account endpoint.
+        async with self.http.get(
+            f"{self.base}/v2/account",
+            headers={"Authorization": f"Bearer {self.token}"},
+        ) as r:
+            account = await r.json() if r.status == 200 else {}
+        self.user_id = (account.get("user") or {}).get("id", "")
+        self.ws = await self.http.ws_connect(
+            f"{self.base}/ws?token={self.token}&format=json"
+        )
+        return self
+
+    async def _recv(self, budget: float) -> dict | None:
+        try:
+            msg = await asyncio.wait_for(self.ws.receive(), budget)
+        except asyncio.TimeoutError:
+            return None
+        except Exception:
+            # Transport torn down (server restart/close mid-soak): a
+            # lost socket costs this op, never the driver.
+            await asyncio.sleep(min(0.2, budget))
+            return None
+        if msg.type.name != "TEXT":
+            # CLOSED/CLOSING/ERROR resolve instantly and forever: back
+            # off so a dead socket burns its op TIMEOUT, not the
+            # driver's event loop (which all real sessions share — a
+            # spin here would inflate EVERY real-tier latency).
+            await asyncio.sleep(min(0.2, budget))
+            return None
+        import json as _json
+
+        env = _json.loads(msg.data)
+        if "matchmaker_ticket" in env:
+            self.acked_tickets.append(
+                env["matchmaker_ticket"].get("ticket", "")
+            )
+        if "matchmaker_matched" in env:
+            self.matched_tickets.append(
+                env["matchmaker_matched"].get("ticket", "")
+            )
+        return env
+
+    async def step(self, op: str, envelope: dict,
+                   reply_key: str | None,
+                   timeout: float = OP_TIMEOUT_S):
+        cid = self._next_cid()
+        env = dict(envelope)
+        env["cid"] = cid
+        t0 = time.perf_counter()
+        try:
+            await self.ws.send_json(env)
+        except Exception:
+            self.record(op, "error", (time.perf_counter() - t0) * 1e3)
+            return None
+        if reply_key is None:
+            # True fire-and-forget (no reply contract on the wire).
+            self.record(op, "ok", (time.perf_counter() - t0) * 1e3)
+            return {}
+        t_end = t0 + timeout
+        while True:
+            rem = t_end - time.perf_counter()
+            if rem <= 0:
+                self.record(op, "timeout", timeout * 1e3)
+                return None
+            got = await self._recv(rem)
+            if got is None:
+                continue
+            if got.get("cid") == cid:
+                ms = (time.perf_counter() - t0) * 1e3
+                if "error" in got:
+                    self.record(op, classify_error_envelope(got), ms)
+                    return None
+                self.record(op, "ok", ms)
+                return got
+            self.inbox.append(got)
+
+    async def step_wait(self, op: str, key: str, timeout: float):
+        t0 = time.perf_counter()
+        for env in list(self.inbox):
+            if key in env:
+                self.inbox.remove(env)
+                self.record(op, "ok", 0.0)
+                return env
+        t_end = t0 + timeout
+        while True:
+            rem = t_end - time.perf_counter()
+            if rem <= 0:
+                self.record(op, "timeout", timeout * 1e3)
+                return None
+            got = await self._recv(rem)
+            if got is None:
+                continue
+            if key in got:
+                self.record(op, "ok", (time.perf_counter() - t0) * 1e3)
+                return got
+            self.inbox.append(got)
+
+    # ----------------------------------------------------- REST surfaces
+
+    async def _rest(self, method: str, path: str, body=None):
+        async with self.http.request(
+            method,
+            f"{self.base}{path}",
+            json=body,
+            headers={"Authorization": f"Bearer {self.token}"},
+        ) as r:
+            return r.status, (
+                await r.json() if r.status == 200 else await r.text()
+            )
+
+    async def storage_write(self, collection: str, key: str, value: str,
+                            version: str) -> tuple[bool, str]:
+        status, body = await self._rest(
+            "PUT",
+            "/v2/storage",
+            {
+                "objects": [
+                    {
+                        "collection": collection,
+                        "key": key,
+                        "value": value,
+                        "version": version,
+                    }
+                ]
+            },
+        )
+        if status != 200:
+            return False, ""
+        acks = (body or {}).get("acks") or []
+        return True, acks[0].get("version", "") if acks else ""
+
+    async def tournament_join(self, tid: str) -> bool:
+        status, _ = await self._rest(
+            "POST", f"/v2/tournament/{tid}/join", {}
+        )
+        return status == 200
+
+    async def tournament_write(self, tid: str, score: int) -> bool:
+        status, _ = await self._rest(
+            "POST", f"/v2/tournament/{tid}", {"score": str(int(score))}
+        )
+        return status == 200
+
+    async def tournament_rank(self, tid: str) -> bool:
+        status, _ = await self._rest("GET", f"/v2/tournament/{tid}")
+        return status == 200
+
+    async def close(self):
+        if self.ws is not None:
+            try:
+                await self.ws.close()
+            except Exception:
+                pass
+
+
+async def run_real_catalog(sessions: list, logger=None) -> None:
+    """Run every catalog scenario once over the given real sessions.
+    `sessions` alternate frontend nodes (a, b, a, b, ...), so each
+    scenario's lead and first partner sit on DIFFERENT nodes — the
+    cross-node proof the soak satellite requires. Episode failures are
+    recorded (outcome=error on op `episode`), never raised: the judge
+    is the verdict."""
+    for name, fn in sorted(CATALOG.items()):
+        need = 1 + getattr(fn, "partners", 0)
+        group = sessions[:need]
+        for s in group:
+            s.scenario = name
+        try:
+            await asyncio.wait_for(
+                fn(group[0], group[1:]), timeout=90.0
+            )
+        except Exception as e:
+            group[0].record("episode", "error")
+            if logger is not None:
+                logger.warn(
+                    "real-tier episode failed", scenario=name,
+                    error=str(e),
+                )
+        # Rotate so node placement varies between scenarios.
+        sessions = sessions[1:] + sessions[:1]
+
+
+# ----------------------------------------------------------------- engine
+
+
+class SoakEngine:
+    """In-process open-loop load engine for ONE node (the modeled
+    tier). Started by the server when ``loadgen.enabled``; reports the
+    live per-scenario SLO table at `/v2/console/soak` and the
+    loadgen_* metric families."""
+
+    def __init__(self, server, cfg, logger: Logger, metrics=None):
+        self.server = server
+        self.cfg = cfg
+        self.logger = logger.with_fields(subsystem="loadgen")
+        self.metrics = metrics
+        self.node = server.config.name
+        self.judge = SoakJudge(metrics=metrics, node=self.node)
+        mix = parse_mix(cfg.mix)
+        rate = float(cfg.arrival_rate_per_s)
+        if rate <= 0:
+            # Little's law: steady population = rate * mean lifetime.
+            rate = max(0.05, cfg.sessions / max(0.1, cfg.lifetime_mean_s))
+        self.model = ArrivalModel(
+            rate, cfg.lifetime_mean_s, cfg.lifetime_sigma, mix,
+            seed=cfg.seed,
+        )
+        self.cap = max(1, int(cfg.max_concurrent or cfg.sessions * 2))
+        self._seq = 0
+        self.active = 0
+        self.spawned = 0
+        self.completed = 0
+        self.shed = 0
+        self.episode_errors = 0
+        self._tasks: set[asyncio.Task] = set()
+        self._stopped = False
+
+    # --------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        # The catalog needs an authoritative core + a standing
+        # tournament on this node; both are idempotent.
+        try:
+            reg = self.server.match_registry
+            if getattr(reg, "_factories", {}).get(ECHO_MATCH_NAME) is None:
+                reg.register(ECHO_MATCH_NAME, EchoMatchCore)
+        except Exception as e:
+            self.logger.warn("echo match register failed", error=str(e))
+        try:
+            # authoritative=False: the catalog's score writes arrive as
+            # CLIENT writes (REST on the real tier) — an authoritative
+            # tournament would 403 them by design.
+            await self.server.tournaments.create(
+                SOAK_TOURNAMENT_ID, duration=86_400,
+                title="soak", max_num_score=1_000_000,
+                authoritative=False,
+            )
+        except Exception as e:
+            self.logger.warn("soak tournament create failed", error=str(e))
+        loop = asyncio.get_running_loop()
+        self._spawn(loop, self._arrival_loop())
+        self._spawn(loop, self._report_loop())
+        self.logger.info(
+            "load engine started (open-loop)",
+            target_sessions=self.cfg.sessions,
+            arrival_rate_per_s=round(self.model.rate, 3),
+            lifetime_mean_s=self.model.lifetime_mean_s,
+            seed=self.model.seed,
+            cap=self.cap,
+            mix={n: w for n, w in zip(self.model.names,
+                                      self.model.weights)},
+        )
+
+    def _spawn(self, loop, coro):
+        task = loop.create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in list(self._tasks):
+            t.cancel()
+        self._tasks.clear()
+
+    # ------------------------------------------------------------- loops
+
+    async def _arrival_loop(self):
+        while not self._stopped:
+            gap, life, scen = self.model.next_arrival()
+            await asyncio.sleep(gap)
+            if self.active >= self.cap:
+                # Explicit, counted protective bound — open-loop means
+                # this is the rig refusing, not the product.
+                self.shed += 1
+                continue
+            self._seq += 1
+            self._spawn(
+                asyncio.get_running_loop(),
+                self._session(self._seq, scen, life),
+            )
+
+    async def _session(self, seq: int, scen_name: str, lifetime_s: float):
+        # Accounting is per SESSION, not per episode: a partnered
+        # scenario's co-actors are real registered sessions too, so
+        # they count against active/spawned (and therefore the cap).
+        self.active += 1
+        self.spawned += 1
+        extra = 0
+        fn = CATALOG[scen_name]
+        ctxs: list[ModeledContext] = []
+        try:
+            need = 1 + getattr(fn, "partners", 0)
+            for i in range(need):
+                self._seq += 1
+                ctx = await ModeledContext(
+                    self.server, self.judge, self._seq
+                ).open()
+                ctxs.append(ctx)
+                if i > 0:
+                    extra += 1
+                    self.active += 1
+                    self.spawned += 1
+            t_end = asyncio.get_running_loop().time() + lifetime_s
+            while (
+                not self._stopped
+                and asyncio.get_running_loop().time() < t_end
+            ):
+                for c in ctxs:
+                    c.scenario = scen_name
+                try:
+                    await asyncio.wait_for(
+                        fn(ctxs[0], ctxs[1:]), timeout=60.0
+                    )
+                except Exception:
+                    self.episode_errors += 1
+                    ctxs[0].record("episode", "error")
+                await asyncio.sleep(0.2)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.episode_errors += 1
+            self.logger.warn(
+                "modeled session failed", scenario=scen_name,
+                error=str(e),
+            )
+        finally:
+            for c in ctxs:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+            self.active -= 1 + extra
+            self.completed += 1 + extra
+
+    async def _report_loop(self):
+        while not self._stopped:
+            self.judge.sample()
+            if self.metrics is not None:
+                g = self.metrics.loadgen_sessions
+                try:
+                    g.labels(tier="modeled", state="active").set(
+                        self.active
+                    )
+                    g.labels(tier="modeled", state="spawned").set(
+                        self.spawned
+                    )
+                    g.labels(tier="modeled", state="completed").set(
+                        self.completed
+                    )
+                    g.labels(tier="modeled", state="shed").set(self.shed)
+                except Exception:
+                    pass
+            await asyncio.sleep(2.0)
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        return {
+            "node": self.node,
+            "tier": "modeled",
+            "target_sessions": self.cfg.sessions,
+            "arrival_rate_per_s": round(self.model.rate, 3),
+            "active": self.active,
+            "spawned": self.spawned,
+            "completed": self.completed,
+            "shed": self.shed,
+            "episode_errors": self.episode_errors,
+        }

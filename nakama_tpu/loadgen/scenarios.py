@@ -1,0 +1,335 @@
+"""The scenario catalog: composable session scripts as async state
+machines over the reference workload surface (PAPER.md: chat, parties,
+authoritative matches, status/notifications, storage, leaderboards,
+tournaments, matchmaking).
+
+Each scenario is one *episode* of a session's behavior — a small state
+machine whose transitions are `ctx.step(...)` calls (send an envelope,
+await the reply key, emit one typed op record with latency + outcome)
+or core-surface ops (`ctx.storage_write`, `ctx.tournament_*`). The
+same scenario body runs over BOTH population tiers: the modeled tier's
+context drives its node's pipeline in-process, the real tier's drives
+a live websocket — every record carries the tier that produced it, so
+the judge never conflates wire truth with modeled throughput.
+
+Scenarios that need co-actors declare `partners`; the engine (modeled)
+or the lab driver (real, placing partners on DIFFERENT frontend nodes)
+supplies peer contexts. Pairing uses a per-episode unique `mk`
+property (`ctx.unique_key()`): with rev_precision=False a bare pool
+query would consume ANY pooled ticket, so every matchmaking scenario
+pins its own cohort — the PR 11 lesson, applied."""
+
+from __future__ import annotations
+
+import time
+
+OP_TIMEOUT_S = 10.0
+MATCH_TIMEOUT_S = 25.0
+
+
+async def _timed(ctx, op: str, coro, ok_of=bool):
+    """Run one core-surface op, record it WITH its latency (the p99
+    half of the SLO gate is dead for an op recorded at 0 ms), and
+    return its raw result."""
+    t0 = time.perf_counter()
+    result = await coro
+    ctx.record(
+        op,
+        "ok" if ok_of(result) else "error",
+        (time.perf_counter() - t0) * 1e3,
+    )
+    return result
+
+
+# --------------------------------------------------------------- match core
+
+
+class EchoMatchCore:
+    """Minimal authoritative match core for the soak catalog: echoes
+    every received message back to all presences. Registered by the
+    soak node runner / engine under the name ``soak_echo``."""
+
+    def match_init(self, ctx, params):
+        return {"echoed": 0}, 10, '{"kind":"soak_echo"}'
+
+    def match_join_attempt(self, ctx, dispatcher, tick, state, presence,
+                           metadata):
+        return state, True, ""
+
+    def match_join(self, ctx, dispatcher, tick, state, presences):
+        return state
+
+    def match_leave(self, ctx, dispatcher, tick, state, presences):
+        return state
+
+    def match_loop(self, ctx, dispatcher, tick, state, messages):
+        for msg in messages:
+            state["echoed"] += 1
+            dispatcher.broadcast_message(
+                msg.op_code, msg.data, sender=msg.sender
+            )
+        return state
+
+    def match_signal(self, ctx, dispatcher, tick, state, data):
+        return state, str(state["echoed"])
+
+    def match_terminate(self, ctx, dispatcher, tick, state, grace_seconds):
+        return state
+
+    def get_state(self, state):
+        return state
+
+
+ECHO_MATCH_NAME = "soak_echo"
+SOAK_TOURNAMENT_ID = "soak-tournament"
+
+
+# ---------------------------------------------------------------- catalog
+
+
+async def matchmake_solo(ctx, partners):
+    """add -> matched across a pinned 1v1 pair (the partner may live on
+    another frontend node: the ticket fans in over the bus either way)."""
+    peer = partners[0]
+    mk = ctx.unique_key()
+    add = {
+        "matchmaker_add": {
+            "query": f"+properties.mk:{mk}",
+            "min_count": 2,
+            "max_count": 2,
+            "string_properties": {"mk": mk},
+        }
+    }
+    a = await ctx.step("add", add, "matchmaker_ticket")
+    b = await peer.step("add", add, "matchmaker_ticket")
+    if a is None or b is None:
+        return
+    await ctx.step_wait("matched", "matchmaker_matched", MATCH_TIMEOUT_S)
+    await peer.step_wait("matched", "matchmaker_matched", MATCH_TIMEOUT_S)
+
+
+matchmake_solo.partners = 1
+
+
+async def party_matchmake(ctx, partners):
+    """party create -> member join -> leader party-matchmake -> matched
+    alongside a pinned solo filler (party of 2 + solo = min_count 3).
+    With the member on another frontend the join/ticket ops cross the
+    bus to the party's authority node."""
+    member, solo = partners[0], partners[1]
+    created = await ctx.step(
+        "party_create", {"party_create": {"open": True}}, "party"
+    )
+    if created is None:
+        return
+    party_id = created["party"]["party_id"]
+    joined = await member.step(
+        "party_join", {"party_join": {"party_id": party_id}}, "party"
+    )
+    mk = ctx.unique_key()
+    ticket = await ctx.step(
+        "party_mm_add",
+        {
+            "party_matchmaker_add": {
+                "party_id": party_id,
+                "query": f"+properties.mk:{mk}",
+                "min_count": 3,
+                "max_count": 3,
+                "string_properties": {"mk": mk},
+            }
+        },
+        "party_matchmaker_ticket",
+    )
+    filler = await solo.step(
+        "add",
+        {
+            "matchmaker_add": {
+                "query": f"+properties.mk:{mk}",
+                "min_count": 3,
+                "max_count": 3,
+                "string_properties": {"mk": mk},
+            }
+        },
+        "matchmaker_ticket",
+    )
+    if ticket is not None and filler is not None:
+        await ctx.step_wait(
+            "matched", "matchmaker_matched", MATCH_TIMEOUT_S
+        )
+        if joined is not None:
+            await member.step_wait(
+                "matched", "matchmaker_matched", MATCH_TIMEOUT_S
+            )
+        await solo.step_wait(
+            "matched", "matchmaker_matched", MATCH_TIMEOUT_S
+        )
+    await ctx.step(
+        "party_close", {"party_close": {"party_id": party_id}}, "cid"
+    )
+
+
+party_matchmake.partners = 2
+
+
+async def match_relay(ctx, partners):
+    """authoritative match create -> partner join -> data round trip.
+    With the partner on another frontend, join admission and data
+    frames route to the match's authority node (cluster/ops.py)."""
+    peer = partners[0]
+    created = await ctx.step(
+        "match_create",
+        {"match_create": {"name": ECHO_MATCH_NAME}},
+        "match",
+    )
+    if created is None:
+        return
+    match_id = created["match"]["match_id"]
+    await peer.step(
+        "match_join", {"match_join": {"match_id": match_id}}, "match"
+    )
+    # Data round trip: the peer sends, the echo core broadcasts, both
+    # (and crucially the CREATOR, across the bus) receive it.
+    await peer.step(
+        "match_data",
+        {
+            "match_data_send": {
+                "match_id": match_id,
+                "op_code": 7,
+                "data": "cGluZw==",  # "ping"
+            }
+        },
+        None,
+    )
+    await ctx.step_wait("data_recv", "match_data", OP_TIMEOUT_S)
+    await peer.step_wait("data_recv", "match_data", OP_TIMEOUT_S)
+    for c in (peer, ctx):
+        await c.step(
+            "match_leave",
+            {"match_leave": {"match_id": match_id}},
+            "cid",
+        )
+
+
+match_relay.partners = 1
+
+
+async def chat_fanout(ctx, partners):
+    """room join + message fanout. Rooms are shared across the whole
+    population (hash-rotated), so message routing fans out to every
+    node holding members — the cross-node chat path under load."""
+    room = f"soak-room-{ctx.seq % 8}"
+    joined = await ctx.step(
+        "join",
+        {"channel_join": {"type": 1, "target": room}},
+        "channel",
+    )
+    if joined is None:
+        return
+    channel_id = joined["channel"]["id"]
+    for i in range(2):
+        await ctx.step(
+            "send",
+            {
+                "channel_message_send": {
+                    "channel_id": channel_id,
+                    "content": '{"n":%d}' % i,
+                }
+            },
+            "channel_message_ack",
+        )
+    await ctx.step(
+        "leave",
+        {"channel_leave": {"channel_id": channel_id}},
+        "cid",
+    )
+
+
+chat_fanout.partners = 0
+
+
+async def status_churn(ctx, partners):
+    """status update + follow churn — the presence-replication write
+    path every connected client exercises continuously."""
+    await ctx.step(
+        "update",
+        {"status_update": {"status": f"soaking-{ctx.seq}"}},
+        "cid",
+    )
+    await ctx.step(
+        "follow",
+        {"status_follow": {"user_ids": [ctx.user_id]}},
+        "status",
+    )
+    await ctx.step(
+        "update",
+        {"status_update": {"status": ""}},
+        "cid",
+    )
+
+
+status_churn.partners = 0
+
+
+async def storage_occ(ctx, partners):
+    """OCC contention on the storage engine: versioned write chain with
+    one deliberately-stale write — the conflict MUST surface (that is
+    the assertion) and the retry with the fresh version must land."""
+    ok, version = await _timed(
+        ctx, "write", ctx.storage_write("soak", "occ", '{"v":1}', ""),
+        ok_of=lambda r: r[0],
+    )
+    if not ok:
+        return
+    ok2, version2 = await _timed(
+        ctx, "write",
+        ctx.storage_write("soak", "occ", '{"v":2}', version),
+        ok_of=lambda r: r[0],
+    )
+    # Stale write: re-using the superseded version hash must conflict.
+    stale_ok, _ = await ctx.storage_write(
+        "soak", "occ", '{"v":3}', version
+    )
+    if stale_ok:
+        ctx.record("occ_conflict", "error")  # conflict NOT detected
+        return
+    if not ok2:
+        return
+    await _timed(
+        ctx, "occ_retry",
+        ctx.storage_write("soak", "occ", '{"v":3}', version2),
+        ok_of=lambda r: r[0],
+    )
+
+
+storage_occ.partners = 0
+
+
+async def tournament_flow(ctx, partners):
+    """tournament join -> score write -> standings read against the
+    node-resident soak tournament (created by the engine at boot)."""
+    ok = await _timed(
+        ctx, "join", ctx.tournament_join(SOAK_TOURNAMENT_ID)
+    )
+    if not ok:
+        return
+    await _timed(
+        ctx, "write",
+        ctx.tournament_write(SOAK_TOURNAMENT_ID, ctx.seq % 1000),
+    )
+    await _timed(
+        ctx, "rank", ctx.tournament_rank(SOAK_TOURNAMENT_ID)
+    )
+
+
+tournament_flow.partners = 0
+
+
+CATALOG = {
+    "matchmake_solo": matchmake_solo,
+    "party_matchmake": party_matchmake,
+    "match_relay": match_relay,
+    "chat_fanout": chat_fanout,
+    "status_churn": status_churn,
+    "storage_occ": storage_occ,
+    "tournament_flow": tournament_flow,
+}

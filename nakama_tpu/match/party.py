@@ -28,6 +28,11 @@ class PartyError(Exception):
 
 
 class PartyHandler:
+    # Cross-node proxies (cluster/ops.py RemotePartyHandler) flip this;
+    # the pipeline uses it to skip local membership side effects that
+    # the authority node performs instead.
+    is_remote = False
+
     def __init__(
         self,
         logger: Logger,
@@ -212,6 +217,10 @@ class PartyHandler:
                 user_id=p.user_id,
                 session_id=p.id.session_id,
                 username=p.meta.username,
+                # Cross-node parties: matched delivery routes each
+                # member's envelope by its ORIGIN node, so the ticket
+                # must carry it (empty = the pool's local default).
+                node=p.id.node,
             )
             for p in self.members.values()
         ]
@@ -240,11 +249,13 @@ class PartyHandler:
     def close(self, leader_session: str, tracker):
         """Leader closes the party: cancel tickets first (the registry entry
         disappears before the pump's leave events arrive), then untrack all
-        members."""
+        members — routed per member node on a clustered registry (a
+        cross-node member's untrack must run on the node that owns its
+        session; the `tracker` parameter stays for call compatibility)."""
         self._require_leader(leader_session)
         self._cancel_tickets()
         for p in list(self.members.values()):
-            tracker.untrack(p.id.session_id, self.stream)
+            self.registry.untrack_presence(p, self.stream)
 
     # ---------------------------------------------------------------- data
 
@@ -303,6 +314,11 @@ class LocalPartyRegistry:
 
     def remove(self, party_id: str):
         self._parties.pop(party_id, None)
+
+    def untrack_presence(self, presence: Presence, stream: Stream):
+        """Untrack one member's presence. Node-local here; the cluster
+        registry overrides this to route by the session's owning node."""
+        self.tracker.untrack(presence.id.session_id, stream)
 
     def join_listener(self):
         """Tracker listener for PARTY streams (reference main.go:162-163)."""

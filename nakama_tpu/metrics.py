@@ -373,6 +373,37 @@ class Metrics:
             "Presences swept from this node's view after a peer death "
             "(leave events fired locally)",
         )
+        self.cluster_party_ops = counter(
+            "cluster_party_ops",
+            "Party operations by op and whether they crossed the bus "
+            "to a remote authority node (crossed=true/false)",
+            ("op", "crossed"),
+        )
+
+        # Load & soak plane (loadgen/): the open-loop session
+        # population by tier (modeled in-process vs real websocket) and
+        # state, every scenario op by outcome, and the per-scenario SLO
+        # burn the soak judge gates on — the "millions of users" claim
+        # is read off these three families plus the judge table.
+        self.loadgen_sessions = gauge(
+            "loadgen_sessions",
+            "Load-rig sessions by tier (modeled, real) and state "
+            "(active, spawned, completed, shed)",
+            ("tier", "state"),
+        )
+        self.loadgen_ops = counter(
+            "loadgen_ops",
+            "Load-rig scenario operations by scenario and outcome "
+            "(ok, error, internal_error, timeout)",
+            ("scenario", "outcome"),
+        )
+        self.slo_scenario_burn_rate = gauge(
+            "slo_scenario_burn_rate",
+            "Per-scenario error-budget burn rate per window (soak "
+            "judge; 1.0 = budget spent exactly at its sustainable "
+            "pace)",
+            ("scenario", "window"),
+        )
 
         # Owner scale-out plane (cluster/sharding.py, replication.py,
         # lease.py): the epoch-versioned shard map (a bump on a shard =

@@ -404,7 +404,31 @@ class Interp:
         if kind == "comma":
             self.eval(node[1], env)
             return self.eval(node[2], env)
+        if kind == "new":
+            return self.eval_new(node, env)
         raise JsRuntimeError(f"unknown expression {kind}")  # pragma: no cover
+
+    def eval_new(self, node, env):
+        """`new Ctor(args)`: prototype-less object construction — a
+        fresh JSObject bound as `this`, the constructor body run, and
+        the object returned unless the body explicitly returns an
+        object/array (the ES constructor contract; primitive returns
+        are discarded)."""
+        _, callee, arg_nodes = node
+        fn = self.eval(callee, env)
+        args = []
+        for a in arg_nodes:
+            if a[0] == "spread":
+                args.extend(self._spread_values(self.eval(a[1], env)))
+            else:
+                args.append(self.eval(a, env))
+        if not isinstance(fn, JSFunction) or fn.is_arrow:
+            raise JsRuntimeError("not a constructor")
+        obj = JSObject()
+        result = self.call_function(fn, args, this=obj)
+        if isinstance(result, (JSObject, JSArray)):
+            return result
+        return obj
 
     def eval_call(self, node, env):
         _, callee, arg_nodes = node

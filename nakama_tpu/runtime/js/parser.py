@@ -457,7 +457,40 @@ class Parser:
                     name = self.next().value
                 return self.function_tail(name)
             if t.value == "new":
-                self.err("new/classes are not supported in this subset")
+                # `new Ctor(args)`: constructor functions (TS compilers
+                # emit these for ES5-target classes). The callee is a
+                # member/index chain WITHOUT call application — the
+                # first '(…)' binds to the `new` as constructor args;
+                # `new Foo` without parens is the zero-arg form.
+                self.next()
+                callee = self.primary()
+                while True:
+                    if self.at_op("."):
+                        self.next()
+                        pt = self.next()
+                        if pt.kind not in ("name", "keyword"):
+                            self.err("expected property name")
+                        callee = ("member", callee, pt.value)
+                    elif self.at_op("["):
+                        self.next()
+                        idx = self.expression()
+                        self.expect("op", "]")
+                        callee = ("index", callee, idx)
+                    else:
+                        break
+                args = []
+                if self.at_op("("):
+                    self.next()
+                    while not self.at_op(")"):
+                        if self.at_op("..."):
+                            self.next()
+                            args.append(("spread", self.assignment()))
+                        else:
+                            args.append(self.assignment())
+                        if self.at_op(","):
+                            self.next()
+                    self.next()
+                return ("new", callee, args)
             self.err(f"unexpected keyword {t.value!r}")
         if t.kind == "op":
             if t.value == "(":

@@ -89,14 +89,19 @@ class NakamaServer:
 
             self.cluster = ClusterPlane(config, log, self.metrics)
         bus = self.cluster.bus if self.cluster is not None else None
+        self._rpc = None
         if bus is not None:
             from .cluster import (
+                BusRpc,
                 ClusterMessageRouter,
                 ClusterSessionRegistry,
                 ClusterStreamManager,
                 ClusterTracker,
             )
 
+            # Correlated request/response over the bus: cross-node
+            # party/match operations (cluster/ops.py) ride it.
+            self._rpc = BusRpc(bus, node, log, self.metrics)
             self.session_registry = ClusterSessionRegistry(
                 log, self.metrics, bus=bus
             )
@@ -134,10 +139,21 @@ class NakamaServer:
             self.stream_manager = LocalStreamManager(
                 log, self.session_registry, self.tracker
             )
-        self.match_registry = LocalMatchRegistry(
-            log, config.match, self.router, node, self.metrics,
-            tracker=self.tracker,
-        )
+        if bus is not None:
+            # Authoritative matches stay single-writer on the node that
+            # created them; joins and data route to that authority over
+            # the bus (cluster/ops.py).
+            from .cluster import ClusterMatchRegistry
+
+            self.match_registry = ClusterMatchRegistry(
+                log, config.match, self.router, node, self.metrics,
+                tracker=self.tracker, bus=bus, rpc=self._rpc,
+            )
+        else:
+            self.match_registry = LocalMatchRegistry(
+                log, config.match, self.router, node, self.metrics,
+                tracker=self.tracker,
+            )
         self.tracker.add_listener(
             StreamMode.MATCH_AUTHORITATIVE, self.match_registry.join_listener()
         )
@@ -325,9 +341,26 @@ class NakamaServer:
                 runtime=None,
             )
         )
-        self.party_registry = LocalPartyRegistry(
-            log, self.tracker, self.router, self.matchmaker, node
-        )
+        if bus is not None:
+            # Parties are owned by their creating node; every operation
+            # routes to that authority (cluster/ops.py), membership
+            # converges through replicated presence events.
+            from .cluster import ClusterPartyRegistry
+
+            self.party_registry = ClusterPartyRegistry(
+                log, self.tracker, self.router, self.matchmaker, node,
+                bus=bus, rpc=self._rpc,
+                session_registry=self.session_registry, config=config,
+            )
+            # Peer death also sweeps its party members (covers the
+            # pre-registered-member window no tracker leave reaches).
+            self.cluster.membership.on_peer_down.append(
+                self.party_registry.sweep_node
+            )
+        else:
+            self.party_registry = LocalPartyRegistry(
+                log, self.tracker, self.router, self.matchmaker, node
+            )
         self.tracker.add_listener(
             StreamMode.PARTY, self.party_registry.join_listener()
         )
@@ -432,6 +465,16 @@ class NakamaServer:
             log, self.leaderboards, self.tournaments, runtime=None
         )
         self.leaderboards.on_change = self.leaderboard_scheduler.update
+
+        # Soak plane (loadgen/): the in-process modeled-session tier of
+        # the load rig — lab posture, off by default.
+        self.soak_engine = None
+        if config.loadgen.enabled:
+            from .loadgen import SoakEngine
+
+            self.soak_engine = SoakEngine(
+                self, config.loadgen, log, self.metrics
+            )
 
         from .api.http import ApiServer
         from .console import ConsoleServer
@@ -730,6 +773,10 @@ class NakamaServer:
                 0 if self.config.socket.port == 0
                 else self.config.socket.grpc_port or self.port - 1,
             )
+        if self.soak_engine is not None:
+            # The load engine starts LAST: every surface it drives is
+            # up, and its first arrivals land on a serving node.
+            await self.soak_engine.start()
         self.logger.info(
             "server listening",
             port=self.port,
@@ -753,6 +800,10 @@ class NakamaServer:
         )
         loop = asyncio.get_running_loop()
         deadline = loop.time() + max(0, grace)
+        if self.soak_engine is not None:
+            # Synthetic load stops before anything drains: the rig must
+            # never hold a shutdown hostage.
+            await self.soak_engine.stop()
         if self.overload is not None:
             # Drain posture FIRST: reject new queue-able work with
             # Retry-After while the front doors finish in-flight

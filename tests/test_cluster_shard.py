@@ -681,3 +681,64 @@ def test_owner_failover_regression_gate_units():
             **{**ok, **patch}
         )
         assert reg and any(needle in r for r in reasons), (patch, reasons)
+
+
+# --------------------------------------------- demotion re-subordination
+
+
+async def test_demoted_owner_resubordinates_as_warm_standby():
+    """PR 11 headroom closed: a superseded owner must not pause
+    forever — it re-announces `standby_of` the new epoch's owner over
+    heartbeats, attaches a fresh ReplicationApplier shadowing it (in
+    need_sync posture, so its first act is a full snapshot request that
+    discards the demoted tenure's divergence), and arms a fresh
+    FailoverMonitor so the fleet can promote BACK without an operator
+    restart."""
+    from nakama_tpu.cluster import ClusterPlane
+    from nakama_tpu.config import Config
+
+    cfg = Config()
+    cfg.name = "o1"
+    cfg.cluster.enabled = True
+    cfg.cluster.role = "device_owner"
+    cfg.cluster.bind = "127.0.0.1:0"
+    cfg.cluster.peers = ["sb=127.0.0.1:1", "f1=127.0.0.1:2"]
+    cfg.cluster.shards = ["o1"]
+    plane = ClusterPlane(cfg, LOG)
+    mm = LocalMatchmaker(LOG, _mm_cfg(), node="o1")
+    plane.wire_matchmaker(mm, recovery=None)
+    # Walk past the boot-grace listen rounds, then self-claim epoch 1.
+    for _ in range(4):
+        plane.lease.heartbeat_payload()
+    assert plane.directory.owner_of("o1") == ("o1", 1)
+
+    # The standby's promoted claim (epoch 2) arrives on a heartbeat:
+    # demotion by higher epoch -> re-subordination.
+    plane._fold_hb("sb", {
+        "claims": [{"shard": "o1", "node": "sb", "epoch": 2}],
+    })
+    assert plane.directory.owner_of("o1") == ("sb", 2)
+    assert "o1" not in plane.lease.owned
+    assert mm._paused  # forms no further matches for the shard
+    # Re-subordinated posture: fresh applier shadowing the NEW owner,
+    # announced over the same heartbeat payload a configured standby
+    # uses, with the promote-back monitor armed.
+    assert plane.resub_standby_of == "sb"
+    assert plane.applier is not None and plane.applier.active
+    assert plane.applier.owner == "sb"
+    assert plane.applier.need_sync  # first act: full snapshot re-sync
+    assert plane._hb_payload().get("standby_of") == "sb"
+    assert plane.monitor is not None and not plane.monitor.promoted
+    assert plane.monitor.shard == "o1" and plane.monitor.node == "o1"
+
+    # Promote-back path: the new owner's lease decays -> this node
+    # re-adopts the shard at epoch 3 and RESUMES its paused pool.
+    assert plane.monitor.check(
+        now=plane.directory._clock() + 10_000.0
+    )
+    await plane.monitor.promote("lease_expired")
+    assert plane.directory.owner_of("o1") == ("o1", 3)
+    assert not plane.applier.active  # zombie ships must not mutate
+    assert not mm._paused
+    assert plane._hb_payload().get("standby_of") is None
+    mm.stop()

@@ -263,6 +263,58 @@ def test_js_rest_params():
     assert out == ["info:/0", "warn:a/1", "err:a,b,c/3", "10", "x|2", "4"]
 
 
+def test_js_new_operator():
+    """Constructor functions via `new` (round-5 #9, next increment
+    toward TS-compiled modules): prototype-less object construction,
+    `this` binding, implicit return of the constructed object, the
+    explicit-object-return override, member-chain callees, the
+    zero-arg `new Foo` form, and spread constructor args."""
+    out, _ = run(
+        """
+        // tsc ES5-target class output style: a constructor function.
+        function Point(x, y) { this.x = x; this.y = y; }
+        var p = new Point(3, 4);
+        console.log(p.x + p.y);
+        // Explicit object return WINS over the constructed `this`...
+        function Box() { this.v = 1; return {inner: 42}; }
+        console.log(new Box().inner);
+        // ...but a primitive return is discarded (ES contract).
+        function Prim() { this.v = 7; return 5; }
+        console.log(new Prim().v);
+        // Member-chain callee: the '(' binds to the `new`.
+        var ns = {Ctor: Point};
+        console.log(new ns.Ctor(10, 20).y);
+        // Zero-arg form without parens.
+        var bare = new Point;
+        console.log(bare.x === undefined);
+        // Spread constructor args.
+        var args = [7, 8];
+        var s = new Point(...args);
+        console.log(s.x + s.y);
+        // Methods assigned in the constructor bind `this` per call.
+        function Counter(start) {
+          this.n = start;
+          this.bump = function () { this.n += 1; return this.n; };
+        }
+        var c = new Counter(10);
+        console.log(c.bump());
+        console.log(c.bump());
+        """
+    )
+    assert out == ["7", "42", "7", "20", "true", "15", "11", "12"]
+
+
+def test_js_new_rejects_non_constructors():
+    import pytest as _pytest
+
+    from nakama_tpu.runtime.js.interp import JsRuntimeError
+
+    with _pytest.raises(JsRuntimeError):
+        run("var f = () => {}; new f();")  # arrows are not constructors
+    with _pytest.raises(JsRuntimeError):
+        run("new 5();")
+
+
 def test_js_host_values_cross_by_conversion():
     out, interp = run("var captured = null;")
     g = interp.globals

@@ -1,0 +1,224 @@
+"""Load-rig units: seeded arrival-model determinism, the soak judge's
+per-scenario/per-tier accounting, table merging, and the named
+`soak_slo_regression` gate semantics (the tier-1-unit-tested contract
+`bench.py --soak` folds into the bench_all_metrics tail + rc).
+
+The full multi-node soak story lives in tests/test_soak_cluster.py
+(subprocess smoke) and `bench.py --soak`; these units must stay cheap
+and deterministic."""
+
+from __future__ import annotations
+
+from fixtures import quiet_logger  # noqa: F401
+
+from nakama_tpu.loadgen import (
+    CATALOG,
+    DEFAULT_MIX,
+    DEFAULT_SLOS,
+    ArrivalModel,
+    SoakJudge,
+    merge_tables,
+    parse_mix,
+    soak_slo_regression,
+)
+from nakama_tpu.loadgen.engine import classify_error_envelope
+
+
+# -------------------------------------------------------- arrival model
+
+
+def test_arrival_model_is_deterministic_per_seed():
+    """One seed = one schedule, bit-for-bit — the reproducibility claim
+    the open-loop model makes (a 1M-session figure must be replayable
+    from the same entry point)."""
+    a = ArrivalModel(5.0, 20.0, 0.8, dict(DEFAULT_MIX), seed=42)
+    b = ArrivalModel(5.0, 20.0, 0.8, dict(DEFAULT_MIX), seed=42)
+    sched_a = a.schedule(30.0)
+    assert sched_a == b.schedule(30.0)
+    assert sched_a, "a 5/s model must arrive within 30s"
+    # schedule() is PURE in the seed: consuming the live stream first
+    # must not change it.
+    for _ in range(10):
+        b.next_arrival()
+    assert b.schedule(30.0) == sched_a
+    # A different seed diverges.
+    c = ArrivalModel(5.0, 20.0, 0.8, dict(DEFAULT_MIX), seed=43)
+    assert c.schedule(30.0) != sched_a
+    # Every row is (t, lifetime, scenario-from-the-catalog), ordered.
+    times = [t for t, _, _ in sched_a]
+    assert times == sorted(times) and times[-1] < 30.0
+    assert all(life > 0 for _, life, _ in sched_a)
+    assert {s for _, _, s in sched_a} <= set(CATALOG)
+
+
+def test_arrival_model_rate_and_lifetime_mean():
+    """The Poisson rate and lognormal MEAN are calibrated, not
+    folklore: over a long horizon the empirical values converge."""
+    m = ArrivalModel(10.0, 20.0, 0.8, dict(DEFAULT_MIX), seed=7)
+    sched = m.schedule(2000.0)
+    rate = len(sched) / 2000.0
+    assert 9.0 < rate < 11.0, rate
+    mean_life = sum(life for _, life, _ in sched) / len(sched)
+    assert 17.0 < mean_life < 23.0, mean_life
+
+
+def test_parse_mix_filters_and_defaults():
+    assert parse_mix([]) == dict(DEFAULT_MIX)
+    mix = parse_mix(["chat_fanout=5", "bogus_scenario=9",
+                     "storage_occ=0.5", "matchmake_solo"])
+    assert mix == {
+        "chat_fanout": 5.0,
+        "storage_occ": 0.5,
+        "matchmake_solo": 1.0,
+    }
+
+
+# ---------------------------------------------------------------- judge
+
+
+def test_judge_accounts_by_scenario_and_tier():
+    j = SoakJudge()
+    for _ in range(8):
+        j.observe("chat_fanout", "send", "ok", 12.0, "modeled")
+    j.observe("chat_fanout", "send", "ok", 15.0, "real")
+    j.observe("chat_fanout", "send", "error", 5.0, "real")
+    j.observe("chat_fanout", "send", "internal_error", 5.0, "modeled")
+    j.observe("chat_fanout", "send", "timeout", 2000.0, "real")
+    row = j.table()["chat_fanout"]
+    assert row["ops"] == 12 and row["ok"] == 9
+    assert row["errors"] == 1
+    assert row["internal_errors"] == 1
+    assert row["timeouts"] == 1
+    assert row["availability"] == round(9 / 12, 5)
+    # The two-tier honesty rule: per-tier counts are explicit.
+    assert row["by_tier"]["modeled"]["ok"] == 8
+    assert row["by_tier"]["modeled"]["internal_error"] == 1
+    assert row["by_tier"]["real"]["ok"] == 1
+    assert row["by_tier"]["real"]["timeout"] == 1
+    # p99 over OK ops only (an error's latency measures the failure
+    # path, not the SLI).
+    assert 0 < row["p99_ms"] <= 15.0
+
+
+def test_merge_tables_sums_counts_and_takes_worst_tails():
+    a = SoakJudge()
+    b = SoakJudge()
+    for _ in range(10):
+        a.observe("storage_occ", "write", "ok", 10.0, "modeled")
+    b.observe("storage_occ", "write", "ok", 500.0, "real")
+    b.observe("storage_occ", "write", "error", 1.0, "real")
+    merged = merge_tables([a.table(), b.table()])
+    row = merged["storage_occ"]
+    assert row["ops"] == 12 and row["ok"] == 11
+    assert row["availability"] == round(11 / 12, 5)
+    assert row["p99_ms"] == 500.0  # worst observed, never flattering
+    assert row["by_tier"]["modeled"]["ok"] == 10
+    assert row["by_tier"]["real"]["ok"] == 1
+
+
+def test_classify_error_envelope():
+    assert classify_error_envelope(
+        {"error": {"code": 13, "message": "internal error"}}
+    ) == "internal_error"
+    assert classify_error_envelope(
+        {"error": {"code": 3, "message": "party full"}}
+    ) == "error"
+
+
+# ----------------------------------------------------------------- gate
+
+
+def _green_table():
+    j = SoakJudge()
+    for name in DEFAULT_SLOS:
+        for tier in ("modeled", "real"):
+            for _ in range(20):
+                j.observe(name, "op", "ok", 50.0, tier)
+    return j.table()
+
+
+def test_soak_slo_regression_gate_semantics():
+    """The named gate: green on a clean table; red on missing
+    coverage, a missing tier, internal errors, lost acked ops,
+    availability/p99/burn breaches — each with a reason naming it."""
+    table = _green_table()
+    reasons, reg = soak_slo_regression(
+        table, min_ops=2, require_tiers=("real",)
+    )
+    assert not reg and not reasons
+
+    # Catalog coverage is part of the verdict.
+    partial = {k: v for k, v in table.items() if k != "chat_fanout"}
+    reasons, reg = soak_slo_regression(partial, min_ops=2)
+    assert reg and any("chat_fanout" in r for r in reasons)
+
+    # A scenario that never ran on the wire fails the two-tier rule.
+    j = SoakJudge()
+    for name in DEFAULT_SLOS:
+        for _ in range(20):
+            j.observe(name, "op", "ok", 50.0, "modeled")
+    reasons, reg = soak_slo_regression(
+        j.table(), min_ops=2, require_tiers=("real",)
+    )
+    assert reg and any("real-tier" in r for r in reasons)
+
+    # Zero-internal-error clause.
+    j = SoakJudge()
+    for name in DEFAULT_SLOS:
+        for tier in ("modeled", "real"):
+            for _ in range(20):
+                j.observe(name, "op", "ok", 50.0, tier)
+    j.observe("storage_occ", "write", "internal_error", 5.0, "modeled")
+    reasons, reg = soak_slo_regression(
+        j.table(), min_ops=2, require_tiers=("real",)
+    )
+    assert reg and any("internal-error" in r for r in reasons)
+
+    # Zero acknowledged-op loss (fed by the bench's audit).
+    reasons, reg = soak_slo_regression(
+        table, min_ops=2, lost_acked_ops=3
+    )
+    assert reg and any("acknowledged" in r for r in reasons)
+
+    # Availability breach.
+    j = SoakJudge()
+    for name in DEFAULT_SLOS:
+        for tier in ("modeled", "real"):
+            for _ in range(20):
+                j.observe(name, "op", "ok", 50.0, tier)
+    for _ in range(30):
+        j.observe("chat_fanout", "send", "error", 5.0, "modeled")
+    reasons, reg = soak_slo_regression(j.table(), min_ops=2)
+    assert reg and any(
+        "chat_fanout" in r and "availability" in r for r in reasons
+    )
+
+    # p99 breach.
+    j = SoakJudge()
+    for name, spec in DEFAULT_SLOS.items():
+        for tier in ("modeled", "real"):
+            for _ in range(20):
+                j.observe(
+                    name, "op", "ok", spec["p99_ms"] * 3.0, tier
+                )
+    reasons, reg = soak_slo_regression(j.table(), min_ops=2)
+    assert reg and any("p99" in r for r in reasons)
+
+    # Burn cap: sustained over-budget badness trips the 1h clause even
+    # when a generous availability target would not.
+    j = SoakJudge()
+    for name in DEFAULT_SLOS:
+        for tier in ("modeled", "real"):
+            for _ in range(20):
+                j.observe(name, "op", "ok", 50.0, tier)
+    for _ in range(10):
+        j.observe("tournament_flow", "op", "error", 5.0, "modeled")
+    slos = {
+        k: dict(v, availability=0.5) for k, v in DEFAULT_SLOS.items()
+    }
+    reasons, reg = soak_slo_regression(
+        j.table(), slos, min_ops=2, burn_max_1h=1.0
+    )
+    assert reg and any(
+        "tournament_flow" in r and "burn" in r for r in reasons
+    )

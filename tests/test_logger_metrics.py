@@ -123,8 +123,12 @@ GOLDEN_EXPOSITION = {
     ("nakama_cluster_bus_queue_depth", "Gauge", ("peer",)),
     ("nakama_cluster_forwards", "Counter", ("op",)),
     ("nakama_cluster_frames", "Counter", ("type", "direction")),
+    ("nakama_cluster_party_ops", "Counter", ("op", "crossed")),
     ("nakama_cluster_peers", "Gauge", ("state",)),
     ("nakama_cluster_presence_sweeps", "Counter", ()),
+    ("nakama_loadgen_ops", "Counter", ("scenario", "outcome")),
+    ("nakama_loadgen_sessions", "Gauge", ("tier", "state")),
+    ("nakama_slo_scenario_burn_rate", "Gauge", ("scenario", "window")),
     ("nakama_cluster_shard_owner", "Gauge", ("shard",)),
     ("nakama_lease_state", "Gauge", ("shard",)),
     ("nakama_owner_takeovers", "Counter", ("reason",)),
