@@ -1211,6 +1211,163 @@ def run_trace_overhead_main() -> int:
     return 1 if regression else 0
 
 
+# ------------------------------------------------------------- fleet obs
+# PR 13: fleet observability plane (cluster/obs.py). The node-side
+# posture must be free: with no collector configured, the exporter's
+# cadence call is one None check; with a collector but nothing newly
+# kept, one bounded cursor read. The gate bills ONE idle exporter call
+# per interval (conservative — the real cadence is >= 1s, i.e. many
+# intervals per call) against the 20.9ms 100k headline.
+
+
+def fleet_obs_overhead_regression(
+    overhead_pct, noop_ns
+) -> tuple[list, bool]:
+    """The fleet-obs gate (named + tier-1-unit-tested like its
+    siblings, so it cannot silently rot): disarmed node-side cost
+    under 1% of the interval budget, and the collector-absent call
+    must stay a one-None-check (< 1µs — a dict lookup creeping in
+    here would tax every non-obs deployment). Returns
+    (reasons, regression)."""
+    reasons = []
+    if overhead_pct >= 1.0:
+        reasons.append(
+            f"disarmed_fleet_obs_overhead {overhead_pct:.4f}% >= 1%"
+            f" of a {TRACE_INTERVAL_BUDGET_MS}ms interval"
+        )
+    if noop_ns >= 1000.0:
+        reasons.append(
+            f"collector-absent exporter call {noop_ns:.0f}ns >="
+            " 1000ns (must stay a single None check)"
+        )
+    return reasons, bool(reasons)
+
+
+def _measure_fleet_obs_costs() -> dict:
+    """Per-call exporter costs, hot: collector-absent no-op, idle
+    cursor read, and the full fragment-build+ingest batch path
+    (collector-local sink — the superset of the wire path's node-side
+    work, which ships the same fragments minus the ingest)."""
+    from nakama_tpu import tracing as trace_api
+    from nakama_tpu.cluster.obs import (
+        FleetTraceStore,
+        TraceFragmentExporter,
+    )
+    from nakama_tpu.logger import test_logger
+
+    trace_api.TRACES.reset()
+    trace_api.TRACES.configure(enabled=True, sample_rate=1.0)
+    out = {}
+
+    # Collector absent: the production posture of every non-obs
+    # deployment — must be one None check.
+    absent = TraceFragmentExporter(
+        None, "n1", "n1", test_logger(), local_sink=None
+    )
+    n = 1_000_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        absent.maybe_ship()
+    out["noop_ns"] = (time.perf_counter() - t0) / n * 1e9
+
+    # Collector present, nothing newly kept: one bounded cursor read
+    # under the trace-store lock.
+    store = FleetTraceStore(capacity=64)
+    idle = TraceFragmentExporter(
+        None, "n1", "n1", test_logger(), local_sink=store
+    )
+    idle.maybe_ship()  # drain whatever the reset left
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        idle.maybe_ship()
+    out["idle_us"] = (time.perf_counter() - t0) / n * 1e6
+
+    # Batch path: K kept traces fragmented + ingested per call
+    # (amortized per trace — the cadence task's cost when traffic
+    # actually keeps traces).
+    rounds, per_round = 200, 8
+    t_total = 0.0
+    for _ in range(rounds):
+        for i in range(per_round):
+            with trace_api.root_span("bench.obs", i=i):
+                pass
+        t0 = time.perf_counter()
+        idle.maybe_ship()
+        t_total += time.perf_counter() - t0
+    out["batch_us_per_trace"] = (
+        t_total / (rounds * per_round) * 1e6
+    )
+    trace_api.TRACES.reset()
+    return out
+
+
+def run_fleet_obs_main() -> int:
+    """`bench.py --fleet-obs`: the fleet-observability overhead proof.
+    Measures the exporter's disarmed costs hot, bills one idle call
+    per 100k-ticket interval (conservative: the real cadence is one
+    call per second or slower), and gates via the named,
+    tier-1-unit-tested `fleet_obs_overhead_regression`. Verdict rides
+    the single `bench_all_metrics` tail line and the exit code."""
+    all_metrics: dict[str, dict] = {}
+
+    def emit_json(obj):
+        print(json.dumps(obj), flush=True)
+        all_metrics[obj["metric"]] = obj
+
+    costs = _measure_fleet_obs_costs()
+    per_interval_us = costs["idle_us"]
+    overhead_pct = (
+        per_interval_us / (TRACE_INTERVAL_BUDGET_MS * 1000.0) * 100.0
+    )
+    reasons, regression = fleet_obs_overhead_regression(
+        overhead_pct, costs["noop_ns"]
+    )
+    emit_json(
+        {
+            "metric": "fleet_obs_disarmed_costs",
+            "value": round(per_interval_us, 4),
+            "unit": "us per 100k-ticket interval (idle exporter call)",
+            **{k: round(v, 4) for k, v in costs.items()},
+        }
+    )
+    emit_json(
+        {
+            "metric": "fleet_obs_overhead_pct",
+            "value": round(overhead_pct, 5),
+            "unit": f"% of a {TRACE_INTERVAL_BUDGET_MS}ms interval",
+            "note": (
+                "one idle exporter call billed per interval; the real"
+                " cadence task runs at >= 1s so the true per-interval"
+                " share is lower still; collector-absent posture is"
+                " the noop_ns figure"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "fleet_obs_overhead_regression",
+            "value": int(regression),
+            "unit": "bool",
+            "regression": regression,
+            "reasons": reasons,
+        }
+    )
+    print(
+        json.dumps(
+            {"metric": "bench_all_metrics", "metrics": all_metrics}
+        ),
+        flush=True,
+    )
+    if regression:
+        print(
+            f"FAIL: fleet obs regression: {'; '.join(reasons)}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return 1 if regression else 0
+
+
 # -------------------------------------------------------- device telemetry
 
 DEVOBS_POOL = int(os.environ.get("BENCH_DEVOBS_POOL", 512))
@@ -3155,6 +3312,26 @@ async def _cluster_node_main():
     cfg.cluster.standby_of = spec.get("standby_of", "")
     cfg.cluster.lease_ms = spec.get("lease_ms", 2000)
     cfg.cluster.lease_grace_ms = spec.get("lease_grace_ms", 3000)
+    # Fleet observability (PR 13): collector designation + cadences,
+    # and the fleet-shared sampling salt that lets the collector
+    # stitch p-sampled traces (without it only error/slow-kept
+    # fragments survive on every node at once).
+    obs = spec.get("obs") or {}
+    if obs.get("collector"):
+        cfg.cluster.obs_collector = obs["collector"]
+    if obs.get("pull_ms"):
+        cfg.cluster.obs_pull_ms = int(obs["pull_ms"])
+    if obs.get("trace_capacity"):
+        cfg.cluster.obs_trace_capacity = int(obs["trace_capacity"])
+    if obs.get("rules"):
+        cfg.cluster.obs_rules = list(obs["rules"])
+    tr = spec.get("tracing") or {}
+    if "sample_rate" in tr:
+        cfg.tracing.sample_rate = float(tr["sample_rate"])
+    if "slow_trace_ms" in tr:
+        cfg.tracing.slow_trace_ms = int(tr["slow_trace_ms"])
+    if tr.get("sample_salt"):
+        cfg.tracing.sample_salt = tr["sample_salt"]
     if spec.get("checkpoint_interval_sec"):
         cfg.recovery.checkpoint_interval_sec = spec[
             "checkpoint_interval_sec"
@@ -3230,7 +3407,7 @@ class _ClusterNode:
                  heartbeat_ms=200, down_after_ms=1200,
                  shards=None, standby_of="", lease_ms=2000,
                  lease_grace_ms=3000, checkpoint_interval_sec=0,
-                 loadgen=None, arm=None):
+                 loadgen=None, arm=None, obs=None, tracing=None):
         import tempfile
 
         self.name = name
@@ -3259,6 +3436,8 @@ class _ClusterNode:
             "checkpoint_interval_sec": checkpoint_interval_sec,
             "loadgen": loadgen or {},
             "arm": arm or [],
+            "obs": obs or {},
+            "tracing": tracing or {},
             "peers": peers,  # filled before spawn
         }
         self.proc = None
@@ -4756,6 +4935,14 @@ def main():
 
         asyncio.run(_cluster_node_main())
         return 0
+    if "--fleet-obs" in sys.argv[1:] or os.environ.get(
+        "BENCH_FLEET_OBS"
+    ):
+        # Fleet-observability-only run: the exporter/collector
+        # overhead proof — separable from the perf sampling like
+        # --trace-overhead, verdict in the same bench_all_metrics
+        # tail line.
+        return run_fleet_obs_main()
     if "--soak" in sys.argv[1:] or os.environ.get("BENCH_SOAK"):
         # Whole-product soak: mixed scenario traffic on a 4-node lab,
         # chaos legs armed mid-run, judged by the per-scenario SLO

@@ -26,6 +26,14 @@ from .matchmaker import (
     cluster_matched_handler,
 )
 from .membership import Membership
+from .obs import (
+    FleetCollector,
+    FleetObsPlane,
+    FleetTraceStore,
+    HealthRuleEngine,
+    TraceFragmentExporter,
+    resolve_collector,
+)
 from .ops import (
     BusRpc,
     ClusterMatchRegistry,
@@ -59,13 +67,19 @@ __all__ = [
     "ClusterTracker",
     "RemotePartyHandler",
     "FailoverMonitor",
+    "FleetCollector",
+    "FleetObsPlane",
+    "FleetTraceStore",
+    "HealthRuleEngine",
     "JournalShipper",
     "LeaseManager",
     "Membership",
     "ReplicationApplier",
     "ShardDirectory",
+    "TraceFragmentExporter",
     "cluster_matched_handler",
     "cluster_peers_signal",
+    "resolve_collector",
     "decode_frames",
     "encode_frame",
     "rendezvous_shard",

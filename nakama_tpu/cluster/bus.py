@@ -304,7 +304,10 @@ class ClusterBus:
         degradation posture, never an unbounded queue or a block. An
         armed raise-mode `cluster.send` propagates to the caller (the
         matchmaker proxy maps it to ErrNotAvailable; chat fan-out
-        catches and counts)."""
+        catches and counts). The frame carries the AMBIENT span's
+        traceparent — the matched publish-back wraps each cohort's
+        delivery in a span continuing its ticket's trace, so route
+        frames land in the same fleet trace the envelope started."""
         if self._stopped:
             return False
         link = self._links.get(peer)
@@ -321,6 +324,11 @@ class ClusterBus:
             "t": frame_type,
             "s": self.node,
             "p": trace_api.current_traceparent() or "",
+            # Send-side wall stamp: the receiver's dispatch span (and
+            # the fleet collector's stitched view) read per-hop bus
+            # latency off it — cross-node clocks, so the collector
+            # corrects it with its offset estimates, skew shown.
+            "w": time.time(),
             "d": body,
         }
         raw = encode_frame(frame, self._pack)
@@ -413,12 +421,16 @@ class ClusterBus:
             ).inc()
         tp = frame.get("p") or ""
         t0 = time.time()
+        sent_at = frame.get("w")
+        span_attrs = {"src": src}
+        if sent_at is not None:
+            span_attrs["bus_sent_at"] = sent_at
         try:
             if tp:
                 # Continue the sender's trace: the bus hop becomes a
                 # span in the SAME trace the envelope started.
                 with trace_api.root_span(
-                    f"cluster.{ftype}", traceparent=tp, src=src
+                    f"cluster.{ftype}", traceparent=tp, **span_attrs
                 ):
                     result = handler(src, frame.get("d") or {})
                     if asyncio.iscoroutine(result):

@@ -706,7 +706,8 @@ class ClusterMatchmakerIngest:
 
 
 def cluster_matched_handler(
-    inner, bus, membership, node: str, logger: Logger, metrics=None
+    inner, bus, membership, node: str, logger: Logger, metrics=None,
+    matchmaker=None,
 ):
     """Wrap the owner's `on_matched` (make_matched_handler) for the
     cluster, per-cohort: cohorts whose every origin node is UP deliver
@@ -717,14 +718,57 @@ def cluster_matched_handler(
     journal as `unpublished`, so a restart re-pools exactly them. An
     interval must never hold its healthy cohorts hostage to one dead
     node, and must never re-pool a cohort whose players already saw
-    the match."""
+    the match.
+
+    With `matchmaker` bound, each healthy cohort delivers inside a
+    ``matchmaker.publish_back`` span continuing its first traced
+    ticket's held trace — the outbound `route`/`mm.matched` frames
+    then carry that traceparent, so the delivery frontend's dispatch
+    span joins the SAME fleet trace the envelope started and the obs
+    collector stitches admission → forward → pool → publish-back →
+    delivery into one tree."""
     log = logger.with_fields(subsystem="matchmaker.cluster")
+
+    def _cohort_trace(entries):
+        if matchmaker is None:
+            return None
+        ctx_of = getattr(matchmaker, "trace_context", None)
+        if ctx_of is None:
+            return None
+        for e in entries:
+            ctx = ctx_of(e.ticket)
+            if ctx is not None:
+                return ctx
+        return None
+
+    def _deliver(entries):
+        inner([entries])
+        notify: dict[str, set[str]] = {}
+        for e in entries:
+            n = e.presence.node or node
+            if n != node:
+                notify.setdefault(n, set()).add(e.ticket)
+        for n, tids in notify.items():
+            try:
+                # Best-effort bookkeeping release: a raise-mode
+                # cluster.send must NOT escape here — the cohort's
+                # players already hold their envelopes, so an escape
+                # would journal the whole batch `unpublished` and
+                # double-deliver after a restart (and skip every
+                # later cohort this interval). A lost release frame
+                # is covered by the frontend's TTL liveness valve.
+                bus.send(n, "mm.matched", {"tickets": sorted(tids)})
+            except Exception as e:
+                log.warn(
+                    "mm.matched release frame send failed (frontend"
+                    " TTL valve will release the bookkeeping)",
+                    peer=n, error=str(e),
+                )
 
     def on_matched(batch):
         healthy = []
         held: set[str] = set()
         held_nodes: set[str] = set()
-        notify: dict[str, set[str]] = {}
         for entries in batch:
             origin_nodes = {e.presence.node or node for e in entries}
             down = [
@@ -736,14 +780,17 @@ def cluster_matched_handler(
                 held_nodes.update(down)
             else:
                 healthy.append(entries)
-                for e in entries:
-                    n = e.presence.node or node
-                    if n != node:
-                        notify.setdefault(n, set()).add(e.ticket)
-        if healthy:
-            inner(healthy)
-            for n, tids in notify.items():
-                bus.send(n, "mm.matched", {"tickets": sorted(tids)})
+        for entries in healthy:
+            ctx = _cohort_trace(entries)
+            if ctx is not None:
+                with trace_api.root_span(
+                    "matchmaker.publish_back",
+                    traceparent=trace_api.format_traceparent(*ctx),
+                    cohort=len(entries),
+                ):
+                    _deliver(entries)
+            else:
+                _deliver(entries)
         if held:
             log.warn(
                 "matched cohorts held: origin node(s) down —"

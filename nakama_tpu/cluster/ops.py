@@ -110,11 +110,13 @@ class BusRpc:
                 # Raise-mode send fault / bus teardown: the OPERATION
                 # fails typed, the caller's session never sees an
                 # internal error.
+                self._count(op, "unavailable")
                 raise ClusterOpError(
                     f"node {peer} unreachable for {op}: {e}",
                     "unavailable",
                 ) from e
             if not sent:
+                self._count(op, "unavailable")
                 raise ClusterOpError(
                     f"node {peer} unreachable for {op}", "unavailable"
                 )
@@ -123,16 +125,31 @@ class BusRpc:
                     fut, timeout if timeout is not None else self.timeout_s
                 )
             except asyncio.TimeoutError:
+                self._count(op, "timeout")
                 raise ClusterOpError(
                     f"{op} timed out at node {peer}", "timeout"
                 ) from None
         finally:
             self._pending.pop(rid, None)
         if not res.get("ok"):
+            self._count(op, res.get("kind", "error"))
             raise ClusterOpError(
                 res.get("error") or op, res.get("kind", "error")
             )
+        self._count(op, "ok")
         return res.get("b") or {}
+
+    def _count(self, op: str, outcome: str) -> None:
+        """`cluster_rpcs{op,outcome}` — the correlated-call ledger the
+        fleet-obs pull cadence (and every party/match op) shows up in.
+        """
+        if self.metrics is not None:
+            try:
+                self.metrics.cluster_rpcs.labels(
+                    op=op, outcome=outcome
+                ).inc()
+            except Exception:
+                pass
 
     async def _on_req(self, src: str, d: dict) -> None:
         rid = d.get("id", "")

@@ -228,6 +228,23 @@ class ClusterPlane:
                     self.logger,
                     metrics=self.metrics,
                 )
+            if recovery is not None:
+                # Shard-ownership epochs ride the PR 7 checkpoint: an
+                # owner WITHOUT a configured standby warm-restarts
+                # from its own journal/checkpoint — but a fresh
+                # directory seeds at epoch 0, so without this its
+                # first self-claim after boot grace would mint epoch 1
+                # and peers remembering a higher epoch (a past
+                # takeover/promote-back history) would refuse every
+                # renewal forever. Restoring the durable epoch before
+                # the first claim closes the PR 12 ROADMAP note: the
+                # standby-less topology restarts to the SAME epoch it
+                # owned, renewals fold everywhere as plain renewals.
+                recovery.register_extra(
+                    "cluster_lease",
+                    self._lease_epochs_snapshot,
+                    self._lease_epochs_restore,
+                )
         elif self.is_standby:
             from .replication import JournalShipper, ReplicationApplier
 
@@ -274,6 +291,38 @@ class ClusterPlane:
                 metrics=self.metrics,
                 heartbeat_s=self.membership.heartbeat_s,
             )
+
+    def _lease_epochs_snapshot(self) -> dict:
+        """Checkpoint extra provider: the epochs of the shards this
+        node currently owns (renewal state only — never another
+        node's claims, which are fleet memory, not ours to persist)."""
+        if self.lease is None:
+            return {}
+        return {
+            shard: self.directory.epoch_of(shard)
+            for shard in sorted(self.lease.owned)
+            if self.directory.epoch_of(shard) > 0
+        }
+
+    def _lease_epochs_restore(self, blob) -> None:
+        """Warm restart: fold the durably-owned epochs back into the
+        fresh directory BEFORE the lease manager's first claim, so the
+        post-boot-grace self-claim renews at the true epoch instead of
+        minting epoch 1 into a fleet that remembers higher. Live
+        claims folded from heartbeats meanwhile still win — claim()'s
+        highest-epoch-wins rule is untouched."""
+        if not blob:
+            return
+        for shard, epoch in blob.items():
+            try:
+                epoch = int(epoch)
+            except (TypeError, ValueError):
+                continue
+            if (
+                shard in self.directory.shards
+                and epoch > self.directory.epoch_of(shard)
+            ):
+                self.directory.claim(shard, self.node, epoch)
 
     def _on_demoted(self, shard: str, new_owner: str, epoch: int):
         """A higher epoch replaced us (we were partitioned through a

@@ -361,6 +361,12 @@ class TracingConfig:
     max_spans_per_trace: int = 64
     # Optional JSONL export: one kept trace per line, appended.
     export_path: str = ""
+    # Fleet-shared p-sampling salt (cluster deployments): with the same
+    # salt on every node, a cross-node trace's fragments are kept or
+    # dropped TOGETHER, so the fleet collector can stitch p-sampled
+    # traces, not only error/slow-kept ones. Empty = per-boot random
+    # salt (the single-node default; still client-unforgeable).
+    sample_salt: str = ""
     # SLO plane: target good-fraction + per-SLI thresholds. Burn rate =
     # bad_fraction / (1 - target) over 5m and 1h windows, published as
     # slo_burn_rate{slo,window}.
@@ -465,6 +471,19 @@ class SocialConfig:
     apple_bundle_id: str = ""
 
 
+# The tunable health-rule thresholds cluster.obs_rules may override
+# (one source of truth shared with cluster/obs.py DEFAULT_RULES —
+# check() rejects unknown names so a typo cannot silently disable a
+# rule).
+OBS_RULE_KEYS = (
+    "burn_1h_max",
+    "replication_lag_max_s",
+    "recompiles_max",
+    "stale_after_ms",
+    "scenario_burn_1h_max",
+)
+
+
 @dataclass
 class ClusterConfig:
     """Multi-process clustering (cluster/): the cross-node bus, sharded
@@ -527,6 +546,24 @@ class ClusterConfig:
     breaker_cooldown_ms: int = 1000
     # Frame codec: json (always available) | msgpack (when installed).
     codec: str = "json"
+    # Fleet observability plane (cluster/obs.py): the collector node
+    # assembling stitched cross-node traces, federated metrics/SLO
+    # views and the health-rule engine. Empty = the device-owner /
+    # first shard owner (the node every ticket already flows through).
+    obs_collector: str = ""
+    # Collector pull cadence (`obs.pull` BusRpc to every node) — also
+    # the health-rule evaluation cadence. Off the hot path by design.
+    obs_pull_ms: int = 2000
+    # Node-side trace-fragment export: batch bound per `obs.frag`
+    # frame (drop-oldest via the kept-ring cursor; losses counted).
+    obs_frag_max: int = 64
+    # Collector-side bounded stitched-trace store.
+    obs_trace_capacity: int = 256
+    # Health-rule threshold overrides as `name=value` entries (see
+    # cluster/obs.py DEFAULT_RULES: burn_1h_max, replication_lag_max_s,
+    # recompiles_max, stale_after_ms, ...). Unknown names are rejected
+    # by check() — a typo must not silently disable a rule.
+    obs_rules: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -692,6 +729,33 @@ class Config:
                 )
             if cl.codec not in ("json", "msgpack"):
                 raise ValueError("cluster.codec must be json or msgpack")
+            if cl.obs_collector and (
+                cl.obs_collector != self.name
+                and cl.obs_collector not in peer_names
+            ):
+                raise ValueError(
+                    "cluster.obs_collector must name this node or a"
+                    " configured peer"
+                )
+            if cl.obs_pull_ms < 100:
+                raise ValueError(
+                    "cluster.obs_pull_ms must be >= 100 (the collector"
+                    " pull cadence is a fleet-wide fan-out)"
+                )
+            for spec in cl.obs_rules:
+                key, sep, value = spec.partition("=")
+                if not sep or key not in OBS_RULE_KEYS:
+                    raise ValueError(
+                        f"cluster.obs_rules entry {spec!r} must be"
+                        f" name=value with name in {OBS_RULE_KEYS}"
+                    )
+                try:
+                    float(value)
+                except ValueError:
+                    raise ValueError(
+                        f"cluster.obs_rules value {value!r} for"
+                        f" {key!r} must be numeric"
+                    ) from None
         if self.session.encryption_key == "defaultencryptionkey":
             warnings.append("session.encryption_key is the insecure default")
         if self.socket.server_key == "defaultkey":

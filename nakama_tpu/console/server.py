@@ -91,6 +91,12 @@ class ConsoleServer:
         r.add_get("/v2/console/match", self._h_match_list)
         r.add_get("/v2/console/matchmaker", self._h_matchmaker)
         r.add_get("/v2/console/cluster", self._h_cluster)
+        r.add_get("/v2/console/fleet", self._h_fleet)
+        r.add_get("/v2/console/fleet/traces", self._h_fleet_traces)
+        r.add_get(
+            "/v2/console/fleet/traces/{trace_id}",
+            self._h_fleet_trace_get,
+        )
         r.add_get("/v2/console/soak", self._h_soak)
         r.add_get("/v2/console/device", self._h_device)
         r.add_post("/v2/console/device/capture", self._h_device_capture)
@@ -814,6 +820,52 @@ class ConsoleServer:
                 "matchmaker_tickets": len(mm),
             }
         )
+
+    async def _h_fleet(self, request: web.Request):
+        """The fleet pane of glass (cluster/obs.py): every node's
+        federated snapshot with staleness marked, the merged scenario
+        SLO table, the shard/lease map, clock-offset estimates, and
+        the health-rule engine's active alerts + OK/WARN/CRITICAL
+        roll-up. Non-collector nodes answer with a pointer at the
+        collector instead of a partial view."""
+        self._auth(request)
+        obs = getattr(self.server, "fleet_obs", None)
+        if obs is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(obs.console_fleet())
+
+    async def _h_fleet_traces(self, request: web.Request):
+        """Stitched fleet traces: newest-first summaries from the
+        collector's fragment store (origin nodes, stitched flag, span
+        counts) plus the per-node fragment-feed ages the staleness
+        marks derive from."""
+        self._auth(request)
+        obs = getattr(self.server, "fleet_obs", None)
+        if obs is None:
+            return web.json_response({"enabled": False})
+        raw = request.query.get("n", 32)
+        try:
+            n = min(256, max(1, int(raw)))
+        except (TypeError, ValueError):
+            return _err(400, f"n must be an integer, got {raw!r}")
+        return web.json_response(obs.console_traces(n))
+
+    async def _h_fleet_trace_get(self, request: web.Request):
+        """One stitched fleet trace: every span annotated with its
+        origin node + clock-offset estimate, and the cross-node hops
+        with per-hop bus latency."""
+        self._auth(request)
+        obs = getattr(self.server, "fleet_obs", None)
+        if obs is None:
+            return web.json_response({"enabled": False})
+        tree = obs.console_trace_get(request.match_info["trace_id"])
+        if tree is None:
+            return _err(
+                404,
+                "fleet trace not found (evicted, never stitched, or"
+                " this node is not the collector)",
+            )
+        return web.json_response(tree)
 
     async def _h_soak(self, request: web.Request):
         """Live soak posture (loadgen/): the open-loop session

@@ -93,6 +93,9 @@ const R = {
   matchState:       ['GET',    '/v2/console/match/{id}/state'],
   matchmaker:       ['GET',    '/v2/console/matchmaker'],
   cluster:          ['GET',    '/v2/console/cluster'],
+  fleet:            ['GET',    '/v2/console/fleet'],
+  fleetTraces:      ['GET',    '/v2/console/fleet/traces'],
+  fleetTraceGet:    ['GET',    '/v2/console/fleet/traces/{trace_id}'],
   soak:             ['GET',    '/v2/console/soak'],
   device:           ['GET',    '/v2/console/device'],
   deviceCapture:    ['POST',   '/v2/console/device/capture'],
@@ -554,6 +557,64 @@ const TABS = {
     // breaker state, local/remote presence split.
     const d = await call('cluster');
     el.appendChild($(jpre(d)));
+  },
+  fleet: async (el) => {
+    // Fleet pane of glass: health roll-up + active alerts, per-node
+    // freshness, the merged scenario SLO table, the shard/lease map,
+    // and the stitched cross-node trace browser (hop latencies +
+    // clock offsets shown per span).
+    const d = await call('fleet');
+    if (!d.enabled || !d.is_collector) {
+      el.appendChild($(jpre(d))); return;
+    }
+    const alerts = ((d.alerts || {}).active || []).map(a =>
+      `<tr><td>${esc(a.rule)}</td><td>${esc(a.subject)}</td>
+       <td>${esc(a.severity)}</td><td>${esc(a.detail)}</td>
+       <td>${esc(a.rounds)}</td></tr>`).join('');
+    const nodes = Object.entries(d.nodes || {}).map(([n, i]) =>
+      `<tr><td>${esc(n)}</td><td>${esc(i.state)}</td>
+       <td>${esc(i.stale ? 'STALE' : 'fresh')}</td>
+       <td>${esc(i.age_ms)}</td>
+       <td>${esc(i.clock_offset_ms)}</td></tr>`).join('');
+    const slo = Object.entries(d.slo_merged || {}).map(([n, r]) =>
+      `<tr><td>${esc(n)}</td><td>${esc(r.ops)}</td>
+       <td>${esc(r.availability)}</td><td>${esc(r.p99_ms)}</td>
+       <td>${esc(r.burn_1h)}</td>
+       <td>${esc(r.internal_errors)}</td></tr>`).join('');
+    el.appendChild($(`<h4>status: ${esc(d.status)}</h4>
+      <h4>active alerts</h4>
+      <table><tr><th>rule</th><th>subject</th><th>sev</th>
+      <th>detail</th><th>rounds</th></tr>${alerts}</table>
+      <h4>nodes</h4>
+      <table><tr><th>node</th><th>state</th><th>fresh</th>
+      <th>age ms</th><th>clock off ms</th></tr>${nodes}</table>
+      <h4>merged scenario SLO table</h4>
+      <table><tr><th>scenario</th><th>ops</th><th>avail</th>
+      <th>p99ms</th><th>burn1h</th><th>interr</th></tr>${slo}</table>
+      <h4>shards</h4>${jpre(d.shards || {})}
+      <h4>recent alert events</h4>
+      ${jpre((d.alerts || {}).recent_events || [])}
+      <div id="ftr"></div><div id="fdet"></div>`));
+    const t = await call('fleetTraces', {}, undefined, { n: 50 });
+    const rows = (t.traces || []).map(x =>
+      `<tr><td><a href="#" data-id="${esc(x.trace_id)}">` +
+      `${esc(x.trace_id)}</a></td><td>${esc(x.root)}</td>` +
+      `<td>${esc((x.nodes || []).join(','))}</td>` +
+      `<td>${esc(x.stitched)}</td><td>${esc(x.n_spans)}</td>` +
+      `<td>${esc(x.extent_ms)}</td><td>${esc(x.status)}</td></tr>`)
+      .join('');
+    el.querySelector('#ftr').innerHTML =
+      `<h4>stitched fleet traces</h4>
+      <table><tr><th>trace</th><th>root</th><th>nodes</th>
+      <th>stitched</th><th>spans</th><th>ms</th><th>status</th>
+      </tr>${rows}</table>`;
+    el.querySelectorAll('#ftr a[data-id]').forEach(a => a.onclick =
+      async (e) => {
+        e.preventDefault();
+        const one = await call('fleetTraceGet',
+          { trace_id: a.dataset.id });
+        el.querySelector('#fdet').innerHTML = jpre(one);
+      });
   },
   soak: async (el) => {
     // Soak posture: open-loop session population + the live

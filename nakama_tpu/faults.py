@@ -63,6 +63,8 @@ FAULT_POINTS = (
     "repl.ship",         # journal tail ship (cluster/replication.py), per batch
     "repl.apply",        # standby shadow-pool apply, per batch
     "lease.renew",       # owner lease claim emission (cluster/lease.py)
+    "obs.frag",          # trace-fragment export ship (cluster/obs.py), per batch
+    "obs.pull",          # collector metrics pull, node-side handler, per pull
 )
 
 

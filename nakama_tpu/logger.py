@@ -27,6 +27,18 @@ from .tracing import current_trace_ids
 
 _LOGFMT_BARE = re.compile(r"^[A-Za-z0-9_.\-/@:+]*$")
 
+# Process-wide node attribution (set by server.py at boot, like the
+# trace store's process-global posture): every record carries the node
+# name next to its trace_id/span_id, so a merged FLEET log stream —
+# the fleet-obs collector's world — attributes each line to the
+# process that wrote it. Empty = single-process default, no extra key.
+_NODE_NAME = ""
+
+
+def set_node_name(name: str) -> None:
+    global _NODE_NAME
+    _NODE_NAME = name or ""
+
 
 def _logfmt_value(v: Any) -> str:
     s = str(v)
@@ -249,6 +261,11 @@ class Logger:
         if ids is not None:
             record.setdefault("trace_id", ids[0])
             record.setdefault("span_id", ids[1])
+        # Fleet attribution: which PROCESS wrote this line (json/
+        # logfmt/stackdriver alike) — without it, merged cluster log
+        # streams are unattributable to a node.
+        if _NODE_NAME:
+            record.setdefault("node", _NODE_NAME)
         if self._fmt == "json":
             line = json.dumps(record, default=str)
         elif self._fmt == "logfmt":

@@ -743,6 +743,15 @@ class LocalMatchmaker:
         if ctx is not None:
             trace_api.TRACES.release(ctx[0])
 
+    def trace_context(self, ticket_id: str) -> tuple[str, str] | None:
+        """(trace_id, span_id) of a held traced ticket, or None — the
+        cluster publish-back stamps outbound route frames with it so
+        the delivery hop joins the ticket's own trace."""
+        ctx = self._ticket_traces.get(ticket_id)
+        if ctx is None:
+            return None
+        return ctx[0], ctx[1]
+
     def _finish_ticket_traces(self, matched_slots, tracing) -> None:
         """Resolve held ticket traces after an interval/collect pass:
         matched tickets get the cohort stage spans (attributed to THEIR

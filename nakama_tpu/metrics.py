@@ -379,6 +379,59 @@ class Metrics:
             "to a remote authority node (crossed=true/false)",
             ("op", "crossed"),
         )
+        self.cluster_rpcs = counter(
+            "cluster_rpcs",
+            "Correlated bus RPCs (op.req/op.res) by op and outcome "
+            "(ok, timeout, unavailable, error, ...) — party/match "
+            "authority ops and the fleet-obs pull cadence",
+            ("op", "outcome"),
+        )
+
+        # Fleet observability plane (cluster/obs.py): the collector's
+        # pane of glass made scrapeable — trace-fragment flow, pull
+        # outcomes, per-node freshness, the stitched-trace inventory,
+        # the clock-offset estimates honesty demands be visible, and
+        # the health-rule engine's alert counts + OK/WARN/CRITICAL
+        # roll-up an operator pages on.
+        self.obs_fragments = counter(
+            "obs_fragments",
+            "Kept-trace fragments exported toward the fleet collector "
+            "by outcome (shipped, dropped)",
+            ("outcome",),
+        )
+        self.obs_pulls = counter(
+            "obs_pulls",
+            "Collector obs.pull rounds per node by outcome (ok, "
+            "timeout, unavailable, error)",
+            ("outcome",),
+        )
+        self.obs_stitched_traces = gauge(
+            "obs_stitched_traces",
+            "Fleet traces retained in the collector's bounded "
+            "stitching store",
+        )
+        self.fleet_nodes = gauge(
+            "fleet_nodes",
+            "Fleet nodes by federation freshness (fresh, stale, down)",
+            ("state",),
+        )
+        self.fleet_clock_offset_ms = gauge(
+            "fleet_clock_offset_ms",
+            "Estimated clock offset per node, collector-minus-node "
+            "(pull-RTT midpoints, EMA; a node running ahead reads "
+            "negative) — the correction stitched cross-node spans "
+            "are annotated with",
+            ("node",),
+        )
+        self.fleet_alerts = gauge(
+            "fleet_alerts",
+            "Active fleet health-rule alerts by rule and severity",
+            ("rule", "severity"),
+        )
+        self.fleet_status = gauge(
+            "fleet_status",
+            "Fleet health roll-up (0 ok, 1 warn, 2 critical)",
+        )
 
         # Load & soak plane (loadgen/): the open-loop session
         # population by tier (modeled in-process vs real websocket) and

@@ -44,6 +44,12 @@ class NakamaServer:
         self.logger = logger or setup_logging(config.logger)
         log = self.logger
         node = config.name
+        # Fleet log attribution: every record this process emits
+        # carries the node name next to its trace ids (logger.py) —
+        # merged fleet log streams are otherwise unattributable.
+        from .logger import set_node_name
+
+        set_node_name(node)
 
         # Persistence (reference DbConnect, main.go:129-133): constructed
         # here, connected in start(). `database=None` builds the embedded
@@ -262,6 +268,7 @@ class NakamaServer:
             max_active=tc.max_active_traces,
             max_spans=tc.max_spans_per_trace,
             export_path=tc.export_path,
+            sample_salt=tc.sample_salt,
             metrics=self.metrics,
         )
         # Device telemetry plane (devobs.py): process-global like the
@@ -476,6 +483,17 @@ class NakamaServer:
                 self, config.loadgen, log, self.metrics
             )
 
+        # Fleet observability plane (cluster/obs.py): trace-fragment
+        # export toward the collector on every node; the collector
+        # node additionally runs the stitching store, the obs.pull
+        # federation loop and the health-rule engine. The read-side
+        # counterpart to the PR 10-12 write-side cluster planes.
+        self.fleet_obs = None
+        if self.cluster is not None and self._rpc is not None:
+            from .cluster import FleetObsPlane
+
+            self.fleet_obs = FleetObsPlane(self, self._rpc)
+
         from .api.http import ApiServer
         from .console import ConsoleServer
 
@@ -500,6 +518,11 @@ class NakamaServer:
             self.config.name,
             self.logger,
             self.metrics,
+            # The publish-back stage stamps each cohort's delivery
+            # frames with its held ticket trace, so the delivery hop
+            # joins the fleet trace the envelope started (obs.py
+            # stitches admission → forward → pool → delivery off it).
+            matchmaker=self.matchmaker,
         )
 
     def attach_runtime(self, runtime):
@@ -571,6 +594,11 @@ class NakamaServer:
             # replication snapshot must never interleave with the
             # store restore above.
             self.cluster.start_failover()
+        if self.fleet_obs is not None:
+            # Fragment export + (collector) federation cadence tasks —
+            # entirely off the hot path; a peer that cannot be pulled
+            # costs freshness (stale-marked view), never a wedge.
+            self.fleet_obs.start()
         if self.runtime is None and (
             self._runtime_modules or self.config.runtime.path
         ):
@@ -840,6 +868,8 @@ class NakamaServer:
                 # Non-WS session implementations keep the plain close.
                 await session.close("server shutting down")
         self.tracker.stop()
+        if self.fleet_obs is not None:
+            self.fleet_obs.stop()
         if self.cluster is not None:
             # After sessions closed (their untrack_all replications ride
             # the bus) and before the durable tail: peers detect this

@@ -45,6 +45,11 @@ import zlib
 from collections import Counter, OrderedDict, deque
 
 # Per-boot salt for the p-sampling hash (see TraceStore._p_sample).
+# Cluster deployments may override it with a fleet-shared secret
+# (config.tracing.sample_salt) so every node keeps the SAME p-sampled
+# trace ids — without that, a cross-node trace's fragments survive
+# tail sampling independently per node and the fleet collector can
+# only stitch the error/slow-kept ones.
 _SAMPLE_SALT = os.urandom(8)
 
 # --------------------------------------------------------------- ledgers
@@ -457,8 +462,17 @@ class TraceStore:
         max_active: int | None = None,
         max_spans: int | None = None,
         export_path: str | None = None,
+        sample_salt: str | None = None,
         metrics=None,
     ) -> None:
+        global _SAMPLE_SALT
+        if sample_salt:
+            # Fleet-shared sampling salt: every node judges a trace id
+            # the same way, so cross-node fragments live or die
+            # together (the stitching prerequisite). Still a secret
+            # w.r.t. clients — traceparent senders cannot mint
+            # always-kept ids without knowing it.
+            _SAMPLE_SALT = sample_salt.encode()
         with self._lock:
             if enabled is not None:
                 self.enabled = bool(enabled)
@@ -685,6 +699,26 @@ class TraceStore:
                         ],
                     }
         return None
+
+    def kept_since(self, cursor: int, limit: int = 64) -> tuple[int, list[dict], int]:
+        """Kept-trace records appended after `cursor` (a `kept_total`
+        watermark), oldest first, at most `limit` — the fleet-obs
+        exporter's incremental read. Returns ``(new_cursor, records,
+        evicted)``: `evicted` counts records that aged out of the
+        bounded ring before this read (the exporter surfaces them as
+        loss, never silence). Records are the store's own dicts —
+        callers must not mutate them."""
+        with self._lock:
+            total = self.kept_total
+            if cursor >= total:
+                return total, [], 0
+            ring_start = total - len(self.kept)
+            start = max(cursor, ring_start)
+            evicted = start - cursor
+            take = list(self.kept)[start - ring_start:]
+            if limit and len(take) > limit:
+                take = take[:limit]
+            return start + len(take), take, evicted
 
     def stats(self) -> dict:
         with self._lock:

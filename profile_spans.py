@@ -7,6 +7,7 @@ the host tail outside the backend (store removal, delivery) is visible.
 
 import gc
 import os
+import sys
 import time
 
 import numpy as np
@@ -132,7 +133,43 @@ def main():
             f" reason={rec['reason']} dur={rec['duration_ms']}ms"
             f" spans={names}"
         )
+    if "--fleet" in sys.argv[1:] or os.environ.get("PROF_FLEET"):
+        print_fleet_chains()
     print_device_report()
+
+
+def print_fleet_chains(n: int = 5):
+    """`--fleet`: run this process's kept traces through the fleet
+    collector's stitching machinery (cluster/obs.py) and print each
+    stitched delivery chain — one line per span in adjusted time
+    order, cross-node hops annotated with their bus latency. Locally
+    there is a single origin node and zero hops; pointed at a
+    collector's store the same printer shows the cross-node chain."""
+    from nakama_tpu.cluster.obs import (
+        FleetTraceStore,
+        TraceFragmentExporter,
+    )
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.tracing import TRACES
+
+    store = FleetTraceStore(capacity=256)
+    exporter = TraceFragmentExporter(
+        None, "local", "local", test_logger(), local_sink=store,
+        max_batch=256,
+    )
+    while exporter.maybe_ship():
+        pass
+    print(f"fleet: {len(store)} stitched trace(s)")
+    for summary in store.summaries(n):
+        print(
+            f"fleet trace {summary['trace_id'][:8]}"
+            f" root={summary['root']}"
+            f" nodes={','.join(summary['nodes'])}"
+            f" stitched={summary['stitched']}"
+            f" extent={summary['extent_ms']}ms"
+        )
+        for line in store.delivery_chain(summary["trace_id"]):
+            print(f"  {line}")
 
 
 if __name__ == "__main__":

@@ -742,3 +742,110 @@ async def test_demoted_owner_resubordinates_as_warm_standby():
     assert not mm._paused
     assert plane._hb_payload().get("standby_of") is None
     mm.stop()
+
+
+# ------------------------------------- no-standby owner warm restart
+
+
+class _RecoveryStub:
+    """Just the surface wire_matchmaker binds: no journal (ship-less
+    topology) + the extras registry the checkpoint extras ride."""
+
+    journal = None
+
+    def __init__(self):
+        self.extras = {}
+
+    def register_extra(self, name, provider, restorer):
+        self.extras[name] = (provider, restorer)
+
+
+def _owner_plane(recovery):
+    from nakama_tpu.cluster import ClusterPlane
+    from nakama_tpu.config import Config
+
+    cfg = Config()
+    cfg.name = "o2"
+    cfg.cluster.enabled = True
+    cfg.cluster.role = "device_owner"
+    cfg.cluster.bind = "127.0.0.1:0"
+    cfg.cluster.peers = ["o1=127.0.0.1:1", "f1=127.0.0.1:2"]
+    cfg.cluster.shards = ["o1", "o2"]
+    # o2-style: NO standby anywhere in this node's world.
+    plane = ClusterPlane(cfg, LOG)
+    mm = LocalMatchmaker(LOG, _mm_cfg(), node="o2")
+    plane.wire_matchmaker(mm, recovery=recovery)
+    return plane, mm
+
+
+def test_no_standby_owner_warm_restarts_to_its_durable_epoch():
+    """ISSUE 13 satellite (the PR 12 ROADMAP note): a shard owner with
+    no configured standby must warm-restart from its OWN
+    journal/checkpoint — including its lease epoch. A fresh directory
+    seeds at epoch 0, so without the `cluster_lease` checkpoint extra
+    the restarted owner's first post-grace self-claim mints epoch 1,
+    which every peer remembering a higher epoch (a past takeover /
+    promote-back history) refuses FOREVER — the pool data restores but
+    the shard is never re-owned. With the extra, the owner restarts to
+    the SAME epoch and renewals fold everywhere as plain renewals."""
+    rec_a = _RecoveryStub()
+    plane_a, mm_a = _owner_plane(rec_a)
+    # wire_matchmaker registered the lease epochs as a checkpoint
+    # extra on the recovery plane (the owner topology, standby or not).
+    assert "cluster_lease" in rec_a.extras
+    provider, _ = rec_a.extras["cluster_lease"]
+    # Walk past boot grace; then simulate a takeover/promote-back
+    # history landing this owner at epoch 3 (FailoverMonitor.adopt's
+    # path mints promoted epochs exactly like this).
+    for _ in range(4):
+        plane_a.lease.heartbeat_payload()
+    assert plane_a.directory.owner_of("o2") == ("o2", 1)
+    plane_a.lease.adopt("o2", 3)
+    assert provider() == {"o2": 3}
+    mm_a.stop()
+
+    # The peer fleet remembers (o2, epoch 3).
+    peer = ShardDirectory("f1", ["o1", "o2"])
+    assert peer.claim("o2", "o2", 3)
+
+    # --- restart WITHOUT the durable epoch (the old failure mode) ---
+    rec_b = _RecoveryStub()
+    plane_b, mm_b = _owner_plane(rec_b)
+    for _ in range(4):
+        body = plane_b.lease.heartbeat_payload()
+    assert body["claims"] == [
+        {"shard": "o2", "node": "o2", "epoch": 1}
+    ]
+    # Every peer refuses the stale-epoch renewal: warm-restarted data,
+    # permanently unowned shard.
+    assert not peer.claim("o2", "o2", 1)
+    assert peer.owner_of("o2") == ("o2", 3)
+    mm_b.stop()
+
+    # --- restart WITH the extra restored before the first claim -----
+    rec_c = _RecoveryStub()
+    plane_c, mm_c = _owner_plane(rec_c)
+    _, restorer = rec_c.extras["cluster_lease"]
+    restorer(provider())  # what recover() applies from the checkpoint
+    assert plane_c.directory.owner_of("o2") == ("o2", 3)
+    for _ in range(4):
+        body = plane_c.lease.heartbeat_payload()
+    assert body["claims"] == [
+        {"shard": "o2", "node": "o2", "epoch": 3}
+    ]
+    assert peer.claim("o2", "o2", 3)  # a plain renewal everywhere
+    assert "o2" in plane_c.lease.owned
+    mm_c.stop()
+
+    # Restore hygiene: junk shards/epochs are ignored, a LOWER durable
+    # epoch never rolls back claims folded live from heartbeats, and a
+    # predates-the-section None blob is a no-op.
+    rec_d = _RecoveryStub()
+    plane_d, mm_d = _owner_plane(rec_d)
+    _, restorer_d = rec_d.extras["cluster_lease"]
+    restorer_d(None)
+    plane_d.directory.claim("o2", "o2", 5)
+    restorer_d({"o2": 3, "ghost": 9, "o1": "junk"})
+    assert plane_d.directory.owner_of("o2") == ("o2", 5)
+    assert plane_d.directory.epoch_of("ghost") == 0
+    mm_d.stop()

@@ -1427,3 +1427,174 @@ async def test_lease_renew_drop_exactly_one_takeover_no_duel():
     finally:
         faults.disarm()
         mm_o.stop()
+
+
+# --------------------------------------------- fleet observability points
+
+
+async def _obs_rig():
+    """Collector 'c' + one observed node 'n' on loopback buses: the
+    smallest rig obs.frag / obs.pull fire on. The observed node's
+    matchmaker interval loop runs throughout — the degradation
+    contract is collector-freshness only, never the node hot path."""
+    from nakama_tpu import tracing as trace_api
+    from nakama_tpu.cluster import ClusterBus, Membership
+    from nakama_tpu.cluster.obs import (
+        FleetCollector,
+        FleetTraceStore,
+        HealthRuleEngine,
+        TraceFragmentExporter,
+        parse_rules,
+    )
+    from nakama_tpu.cluster.ops import BusRpc
+    from nakama_tpu.cluster.sharding import ShardDirectory
+
+    log = quiet_logger()
+    trace_api.TRACES.reset()
+    trace_api.TRACES.configure(enabled=True, sample_rate=1.0)
+    bus_c = ClusterBus("c", "127.0.0.1:0", {}, log)
+    bus_n = ClusterBus("n", "127.0.0.1:0", {}, log)
+    await bus_c.start()
+    await bus_n.start()
+    bus_c.add_peer("n", f"127.0.0.1:{bus_n.port}")
+    bus_n.add_peer("c", f"127.0.0.1:{bus_c.port}")
+    store = FleetTraceStore()
+    bus_c.on(
+        "obs.frag",
+        lambda src, d: (
+            [store.ingest(src, f) for f in d.get("frags") or ()],
+            store.note_batch(src, d.get("evicted", 0)),
+        ),
+    )
+    rpc_c = BusRpc(bus_c, "c", log)
+    rpc_n = BusRpc(bus_n, "n", log)
+
+    def on_pull(src, body):
+        if faults.fire("obs.pull"):
+            raise InjectedFault("obs.pull")
+        return {"node": "n", "wall": time.time(), "slo": {},
+                "cluster": {}, "devobs": {}, "breakers": {}}
+
+    rpc_n.register("obs.pull", on_pull)
+    member_c = Membership(bus_c, log, heartbeat_ms=50,
+                          down_after_ms=60_000)
+    member_c.note_frame("n")  # liveness via real traffic
+    engine = HealthRuleEngine(parse_rules(["stale_after_ms=300"]), log)
+    collector = FleetCollector(
+        rpc_c, member_c, ShardDirectory("c", ["c"]), "c",
+        lambda: {"node": "c", "wall": time.time()},
+        engine, store, log, pull_ms=100,
+    )
+    exporter = TraceFragmentExporter(bus_n, "n", "c", log)
+    mm = LocalMatchmaker(
+        log,
+        MatchmakerConfig(backend="cpu", pool_capacity=64,
+                         max_tickets=64),
+        node="n",
+    )
+    return {
+        "buses": (bus_c, bus_n), "store": store, "engine": engine,
+        "collector": collector, "exporter": exporter, "mm": mm,
+        "trace_api": trace_api,
+    }
+
+
+async def _obs_rig_down(rig):
+    for b in rig["buses"]:
+        await b.stop()
+    rig["trace_api"].TRACES.reset()
+
+
+async def test_obs_frag_drop_collector_goes_stale_node_hot_path_unharmed():
+    """Armed obs.frag drop: fragment batches are lost — counted, the
+    cursor advances (frame-loss posture) — so the collector's stitched
+    view goes STALE (its fragment feed stops refreshing) while the
+    node's own interval loop and trace keeping run untouched. Disarm:
+    fresh traces ship and the feed heals. Never a wedge, never an
+    exception out of the exporter cadence."""
+    rig = await _obs_rig()
+    exporter, store, mm = rig["exporter"], rig["store"], rig["mm"]
+    trace_api = rig["trace_api"]
+    try:
+        with trace_api.root_span("seed"):
+            pass
+        assert exporter.maybe_ship() == 1
+        await asyncio.sleep(0.3)
+        assert len(store) == 1
+        feed_at = store.frag_at["n"]
+
+        faults.arm("obs.frag", "drop", probability=1.0)
+        for i in range(5):
+            # The node hot path: traces keep being made and kept, the
+            # interval loop keeps ticking — obs is read-side only.
+            with trace_api.root_span(f"lost{i}"):
+                pass
+            mm.add(
+                [MatchmakerPresence(f"u{i}", f"s{i}", node="n")],
+                f"s{i}", "", "+properties.x:never", 2, 2,
+            )
+            mm.process()
+            assert exporter.maybe_ship() == 0  # dropped, not raised
+        await asyncio.sleep(0.2)
+        assert faults.PLANE.fired.get("obs.frag", 0) >= 5
+        assert exporter.dropped == 5
+        assert len(store) == 1  # nothing new landed
+        assert store.frag_at["n"] == feed_at  # the feed went stale
+        assert len(mm) == 5  # the node never noticed
+
+        faults.disarm("obs.frag")
+        with trace_api.root_span("healed"):
+            pass
+        assert exporter.maybe_ship() == 1
+        await asyncio.sleep(0.3)
+        assert store.frag_at["n"] > feed_at  # feed fresh again
+        roots = {s["root"] for s in store.summaries(10)}
+        assert "healed" in roots and "lost0" not in roots
+    finally:
+        faults.disarm()
+        rig["mm"].stop()
+        await _obs_rig_down(rig)
+
+
+async def test_obs_pull_raise_keeps_last_known_flags_stale_never_wedges():
+    """Armed obs.pull raise: every federation round fails for the
+    node — the collector KEEPS serving its last-known snapshot, marks
+    it stale once the feed ages past the threshold, raises node_stale
+    through the rule engine, and its loop keeps running. Disarm: the
+    next round refreshes, staleness clears, the alert heals."""
+    rig = await _obs_rig()
+    collector, engine = rig["collector"], rig["engine"]
+    try:
+        await collector.pull_round()
+        assert collector.snapshots["n"]["data"]["node"] == "n"
+        assert not collector.view()["nodes"]["n"]["stale"]
+        assert engine.status() == 0  # OK
+
+        faults.arm("obs.pull", "raise", probability=1.0)
+        failed_before = collector.pulls_failed
+        rounds_before = collector.rounds
+        await asyncio.sleep(0.35)  # age past stale_after_ms=300
+        for _ in range(3):
+            await collector.pull_round()  # never wedges, never raises
+        assert collector.rounds == rounds_before + 3
+        assert collector.pulls_failed > failed_before
+        assert faults.PLANE.fired.get("obs.pull", 0) >= 3
+        view = collector.view()
+        assert view["nodes"]["n"]["data"] is not None  # last-known
+        assert view["nodes"]["n"]["stale"]
+        assert ("node_stale", "n") in engine.active
+
+        faults.disarm("obs.pull")
+        await collector.pull_round()
+        view = collector.view()
+        assert not view["nodes"]["n"]["stale"]
+        assert ("node_stale", "n") not in engine.active  # healed
+        healed = [
+            e for e in engine.ledger.recent(16)
+            if e["event"] == "healed" and e["rule"] == "node_stale"
+        ]
+        assert healed
+    finally:
+        faults.disarm()
+        rig["mm"].stop()
+        await _obs_rig_down(rig)

@@ -1,0 +1,819 @@
+"""Fleet observability plane units + the tier-1 3-node smoke.
+
+Units (in-process, port-0 buses like test_cluster_shard.py): the
+kept-ring fragment cursor, exporter batching/no-op posture, cross-node
+stitching with clock-offset annotation and per-hop bus latency, the
+health-rule engine's raise/update/heal lifecycle, the collector's
+pull federation with staleness marking, and the
+`fleet_obs_overhead_regression` bench gate semantics.
+
+The smoke boots three real NakamaServer processes (device-owner =
+collector + 2 loadgen frontends) via the same `bench.py
+--cluster-node` runner every cluster proof uses, and asserts the
+ISSUE's acceptance story end-to-end: one cross-node add→matched
+request renders as ONE stitched fleet trace on the collector console
+(frontend, owner and delivery spans present with origin-node +
+clock-offset annotations), `/v2/console/fleet` serves merged
+metrics/SLO/shard-map for all live nodes, and SIGKILL of a frontend
+raises a `peer_down` alert that heals when the node returns.
+
+Chaos legs for `obs.frag` / `obs.pull` live in test_faults_chaos.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import tempfile
+import time
+
+import bench
+
+from fixtures import quiet_logger
+
+from nakama_tpu import faults
+from nakama_tpu import tracing as trace_api
+from nakama_tpu.cluster import ClusterBus, Membership, ShardDirectory
+from nakama_tpu.cluster.obs import (
+    CRITICAL,
+    DEFAULT_RULES,
+    OK,
+    WARN,
+    FleetCollector,
+    FleetTraceStore,
+    HealthRuleEngine,
+    TraceFragmentExporter,
+    parse_rules,
+    resolve_collector,
+)
+from nakama_tpu.cluster.ops import BusRpc
+from nakama_tpu.tracing import TRACES
+
+LOG = quiet_logger()
+
+
+# ---------------------------------------------------------- rig helpers
+
+
+async def _mk_bus(node):
+    bus = ClusterBus(node, "127.0.0.1:0", {}, LOG)
+    await bus.start()
+    return bus
+
+
+async def _link(*buses):
+    for a in buses:
+        for b in buses:
+            if a is not b:
+                a.add_peer(b.node, f"127.0.0.1:{b.port}")
+
+
+async def _drain(seconds=0.3):
+    await asyncio.sleep(seconds)
+
+
+def _keep_trace(name="t", **attrs):
+    """One kept trace in the process-global store (rate 1.0)."""
+    with trace_api.root_span(name, **attrs):
+        pass
+
+
+def _span(node_hint, span_id, parent_id, name, start_s, dur_ms,
+          **attrs):
+    return {
+        "traceId": "f" * 32,
+        "spanId": span_id,
+        "parentSpanId": parent_id,
+        "name": name,
+        "startTimeUnixNano": int(start_s * 1e9),
+        "endTimeUnixNano": int((start_s + dur_ms / 1000.0) * 1e9),
+        "durationMs": dur_ms,
+        "status": {"code": "OK", "message": ""},
+        **({"attributes": attrs} if attrs else {}),
+    }
+
+
+# -------------------------------------------------------- kept_since API
+
+
+def test_kept_since_cursor_and_eviction():
+    """The exporter's incremental read: monotone cursor, bounded
+    batches, and eviction surfaced as a count instead of silence."""
+    TRACES.reset()
+    TRACES.configure(enabled=True, sample_rate=1.0, capacity=4)
+    try:
+        cur, recs, ev = TRACES.kept_since(0)
+        assert (cur, recs, ev) == (0, [], 0)
+        for i in range(3):
+            _keep_trace(f"t{i}")
+        cur, recs, ev = TRACES.kept_since(0, limit=2)
+        assert cur == 2 and len(recs) == 2 and ev == 0
+        cur, recs, ev = TRACES.kept_since(cur)
+        assert cur == 3 and len(recs) == 1 and ev == 0
+        # Ring of 4: six more keeps evict everything below the window.
+        for i in range(6):
+            _keep_trace(f"u{i}")
+        cur, recs, ev = TRACES.kept_since(cur, limit=64)
+        assert cur == 9 and len(recs) == 4
+        assert ev == 2  # records 4-5 aged out before the read
+    finally:
+        TRACES.reset()
+
+
+# --------------------------------------------------------------- exporter
+
+
+def test_exporter_collector_absent_is_noop():
+    ex = TraceFragmentExporter(None, "n1", "n1", LOG, local_sink=None)
+    TRACES.reset()
+    TRACES.configure(enabled=True, sample_rate=1.0)
+    try:
+        _keep_trace("x")
+        assert ex.maybe_ship() == 0
+        assert ex.stats()["cursor"] == 0  # never even reads the ring
+    finally:
+        TRACES.reset()
+
+
+def test_exporter_ships_bounded_batches_to_local_sink():
+    TRACES.reset()
+    TRACES.configure(enabled=True, sample_rate=1.0)
+    try:
+        store = FleetTraceStore()
+        ex = TraceFragmentExporter(
+            None, "n1", "n1", LOG, max_batch=2, local_sink=store
+        )
+        for i in range(5):
+            _keep_trace(f"t{i}")
+        assert ex.maybe_ship() == 2  # bounded batch
+        assert ex.maybe_ship() == 2
+        assert ex.maybe_ship() == 1
+        assert ex.maybe_ship() == 0
+        assert len(store) == 5 and store.fragments == 5
+        assert store.frag_ages_ms().get("n1") is not None
+        assert ex.shipped == 5 and ex.dropped == 0
+    finally:
+        TRACES.reset()
+
+
+async def test_exporter_ships_over_the_bus():
+    TRACES.reset()
+    TRACES.configure(enabled=True, sample_rate=1.0)
+    bus_a = await _mk_bus("a")
+    bus_b = await _mk_bus("b")
+    try:
+        await _link(bus_a, bus_b)
+        store = FleetTraceStore()
+        got = []
+        bus_a.on(
+            "obs.frag",
+            lambda src, d: (
+                got.append(src),
+                [store.ingest(src, f) for f in d.get("frags") or ()],
+                store.note_batch(src, d.get("evicted", 0)),
+            ),
+        )
+        ex = TraceFragmentExporter(bus_b, "b", "a", LOG)
+        _keep_trace("wire", leg=1)
+        assert ex.maybe_ship() == 1
+        await _drain()
+        assert got == ["b"]
+        assert len(store) == 1
+        summary = store.summaries(1)[0]
+        assert summary["nodes"] == ["b"]
+        assert not summary["stitched"]
+    finally:
+        await bus_a.stop()
+        await bus_b.stop()
+        TRACES.reset()
+
+
+def test_exporter_frag_fault_costs_batch_then_heals():
+    """Armed obs.frag (drop AND raise): the batch is lost — counted,
+    cursor advanced, caller never sees an exception — and fresh traces
+    ship after disarm (the stale-then-heal chaos contract's unit)."""
+    TRACES.reset()
+    TRACES.configure(enabled=True, sample_rate=1.0)
+    try:
+        store = FleetTraceStore()
+        ex = TraceFragmentExporter(
+            None, "n1", "n1", LOG, local_sink=store
+        )
+        _keep_trace("lost1")
+        with faults.armed_ctx("obs.frag", mode="drop"):
+            assert ex.maybe_ship() == 0
+        _keep_trace("lost2")
+        with faults.armed_ctx("obs.frag", mode="raise"):
+            assert ex.maybe_ship() == 0  # caught, never escapes
+        assert ex.dropped == 2 and len(store) == 0
+        _keep_trace("kept")
+        assert ex.maybe_ship() == 1  # heals
+        assert [s["root"] for s in store.summaries(5)] == ["kept"]
+    finally:
+        TRACES.reset()
+
+
+# -------------------------------------------------------------- stitching
+
+
+def test_store_stitches_cross_node_with_offsets_and_hops():
+    """Fragments from three nodes sharing one trace id stitch into one
+    tree: origin + clock-offset annotations on every span, and the
+    cross-node hops measured from the frame's send-side wall stamp,
+    offset-corrected."""
+    store = FleetTraceStore()
+    base = 1000.0
+    # f1: envelope root (no parent) + the mm.add client span.
+    store.ingest("f1", {
+        "trace_id": "f" * 32, "root": "pipeline.matchmaker_add",
+        "status": "ok", "reason": "sampled", "n_spans": 1, "ts": base,
+        "spans": [_span("f1", "a" * 16, "", "pipeline.matchmaker_add",
+                        base, 5.0)],
+    })
+    # owner: bus dispatch span continuing f1's span, with the frame's
+    # send stamp; owner clock runs 100ms AHEAD (its timestamps need
+    # -0.1s to align).
+    skew = 0.100
+    store.ingest("o1", {
+        "trace_id": "f" * 32, "root": "cluster.mm.add",
+        "status": "ok", "reason": "sampled", "n_spans": 2, "ts": base,
+        "spans": [
+            _span("o1", "b" * 16, "a" * 16, "cluster.mm.add",
+                  base + 0.002 + skew, 1.0,
+                  bus_sent_at=base + 0.001),
+            _span("o1", "c" * 16, "b" * 16, "matchmaker.add",
+                  base + 0.003 + skew, 0.5),
+        ],
+    })
+    # f2: the delivery hop (publish-back route frame).
+    store.ingest("f2", {
+        "trace_id": "f" * 32, "root": "cluster.route",
+        "status": "ok", "reason": "sampled", "n_spans": 1, "ts": base,
+        "spans": [_span("f2", "d" * 16, "c" * 16, "cluster.route",
+                        base + 0.010, 0.8,
+                        bus_sent_at=base + 0.009 + skew)],
+    })
+    offsets = {"f1": 0.0, "o1": -skew, "f2": 0.0}
+    summary = store.summaries(1)[0]
+    assert summary["stitched"] and summary["nodes"] == ["f1", "f2", "o1"]
+    assert summary["root"] == "pipeline.matchmaker_add"
+    tree = store.stitched("f" * 32, offsets)
+    assert tree["stitched"]
+    by_name = {sp["name"]: sp for sp in tree["spans"]}
+    assert by_name["matchmaker.add"]["originNode"] == "o1"
+    assert by_name["matchmaker.add"]["clockOffsetMs"] == -100.0
+    # Adjusted order: admission → forward → pool → delivery, despite
+    # the owner's raw timestamps being 100ms in the future.
+    assert [sp["name"] for sp in tree["spans"]] == [
+        "pipeline.matchmaker_add", "cluster.mm.add",
+        "matchmaker.add", "cluster.route",
+    ]
+    hops = {(h["from"], h["to"]): h for h in tree["hops"]}
+    add_hop = hops[("f1", "o1")]
+    assert add_hop["basis"] == "frame_sent"
+    # recv (base+0.002+skew, adjusted -skew) - sent (base+0.001) = 1ms.
+    assert abs(add_hop["latency_ms"] - 1.0) < 0.01
+    route_hop = hops[("o1", "f2")]
+    # recv base+0.010 - sent (base+0.009+skew adjusted -skew) = 1ms.
+    assert abs(route_hop["latency_ms"] - 1.0) < 0.01
+    # The printable chain carries every span + its hop annotation.
+    chain = store.delivery_chain("f" * 32, offsets)
+    assert len(chain) == 4
+    assert any("hop f1->o1" in line for line in chain)
+    assert any("hop o1->f2" in line for line in chain)
+
+
+def test_store_bounded_capacity_and_span_cap():
+    store = FleetTraceStore(capacity=2, max_spans=8)
+    for i in range(4):
+        store.ingest("n", {
+            "trace_id": f"{i:032x}", "root": f"r{i}", "status": "ok",
+            "reason": "sampled", "n_spans": 0, "ts": float(i),
+            "spans": [],
+        })
+    assert len(store) == 2  # oldest evicted
+    tids = {s["trace_id"] for s in store.summaries(10)}
+    assert tids == {f"{2:032x}", f"{3:032x}"}
+    big = {
+        "trace_id": "e" * 32, "root": "big", "status": "ok",
+        "reason": "sampled", "n_spans": 20, "ts": 0.0,
+        "spans": [
+            _span("n", f"{j:016x}", "", f"s{j}", 1.0 + j, 1.0)
+            for j in range(20)
+        ],
+    }
+    store.ingest("n", big)
+    tree = store.stitched("e" * 32)
+    assert len(tree["spans"]) == 8 and tree["truncated"]
+    assert store.span_drops >= 1
+
+
+# ------------------------------------------------------------ rule engine
+
+
+def _clean_view():
+    return {
+        "nodes": {
+            "o1": {
+                "state": "self", "age_ms": 10.0, "stale": False,
+                "data": {
+                    "slo": {"burn_rates": {"api_latency": {
+                        "5m": 0.0, "1h": 0.0}}},
+                    "cluster": {}, "devobs": {"recompiles_total": 0},
+                    "breakers": {"matchmaker_backend": "closed"},
+                },
+            },
+            "f1": {
+                "state": "up", "age_ms": 20.0, "stale": False,
+                "data": {"slo": {}, "cluster": {}, "devobs": {},
+                         "breakers": {}},
+            },
+        },
+        "shards": {"o1": {"node": "o1", "epoch": 1, "lease": "held",
+                          "silent_s": 0.1}},
+        "slo_merged": {"matchmake_solo": {"burn_1h": 0.0}},
+    }
+
+
+def test_rule_engine_raise_update_heal_lifecycle():
+    engine = HealthRuleEngine(None, LOG)
+    assert engine.evaluate(_clean_view()) == OK
+    assert engine.active == {} and engine.status() == OK
+
+    bad = _clean_view()
+    bad["nodes"]["f1"]["state"] = "down"
+    bad["nodes"]["o1"]["data"]["slo"]["burn_rates"]["api_latency"][
+        "1h"
+    ] = 2.5
+    bad["shards"]["o1"]["lease"] = "expired"
+    assert engine.evaluate(bad) == CRITICAL
+    keys = set(engine.active)
+    assert ("peer_down", "f1") in keys
+    assert ("burn_rate", "o1:api_latency") in keys
+    assert ("lease_expired", "o1") in keys
+    first = engine.active[("peer_down", "f1")]
+    assert first["severity"] == "critical"
+    assert first["healed_at"] is None
+    t_first = first["first_seen"]
+    raised_events = [
+        e for e in engine.ledger.recent(32) if e["event"] == "raised"
+    ]
+    assert len(raised_events) == 3
+
+    # Persisting condition: same alert object updates, no new event.
+    assert engine.evaluate(bad) == CRITICAL
+    again = engine.active[("peer_down", "f1")]
+    assert again["first_seen"] == t_first and again["rounds"] == 2
+    assert len([
+        e for e in engine.ledger.recent(32) if e["event"] == "raised"
+    ]) == 3
+
+    # Conditions clear: every alert heals with a timestamp, exactly
+    # one healed event each — never log/ledger spam.
+    assert engine.evaluate(_clean_view()) == OK
+    assert engine.active == {}
+    healed = [
+        e for e in engine.ledger.recent(32) if e["event"] == "healed"
+    ]
+    assert {(e["rule"], e["subject"]) for e in healed} == {
+        ("peer_down", "f1"),
+        ("burn_rate", "o1:api_latency"),
+        ("lease_expired", "o1"),
+    }
+
+
+def test_rule_engine_full_rule_table():
+    """Every declared rule fires on its condition: stale node, grace
+    lease, replication lag past the checkpoint interval, recompiles,
+    open breaker, merged scenario burn."""
+    engine = HealthRuleEngine(None, LOG)
+    view = _clean_view()
+    view["nodes"]["f1"]["stale"] = True
+    view["nodes"]["f1"]["age_ms"] = 99999.0
+    view["shards"]["o1"]["lease"] = "grace"
+    view["nodes"]["o1"]["data"]["cluster"]["replication"] = {
+        "standby": "sb", "lag_sec": 120.0,
+    }
+    view["nodes"]["o1"]["data"]["checkpoint_interval_sec"] = 60
+    view["nodes"]["o1"]["data"]["devobs"]["recompiles_total"] = 2
+    view["nodes"]["o1"]["data"]["breakers"][
+        "matchmaker_backend"
+    ] = "open"
+    view["slo_merged"]["matchmake_solo"]["burn_1h"] = 3.0
+    status = engine.evaluate(view)
+    assert status == WARN
+    rules = {k[0] for k in engine.active}
+    assert rules == {
+        "node_stale", "lease_grace", "replication_lag",
+        "recompiles", "breaker_open", "scenario_burn",
+    }
+    # Config-tunable: raising the thresholds silences the tunable
+    # rules on the same view.
+    loose = HealthRuleEngine(
+        parse_rules([
+            "replication_lag_max_s=1000", "recompiles_max=10",
+            "scenario_burn_1h_max=10",
+        ]),
+        LOG,
+    )
+    loose.evaluate(view)
+    assert {k[0] for k in loose.active} == {
+        "node_stale", "lease_grace", "breaker_open",
+    }
+
+
+def test_rule_defaults_match_config_contract():
+    from nakama_tpu.config import OBS_RULE_KEYS
+
+    assert set(DEFAULT_RULES) == set(OBS_RULE_KEYS)
+    assert parse_rules(["burn_1h_max=2.5"]) == {"burn_1h_max": 2.5}
+    assert parse_rules(["nonsense=1"]) == {}
+
+
+def test_resolve_collector_defaults():
+    from nakama_tpu.config import Config
+
+    c = Config()
+    c.name = "o1"
+    c.cluster.role = "device_owner"
+    assert resolve_collector(c) == "o1"
+    c.cluster.shards = ["oA", "oB"]
+    assert resolve_collector(c) == "oA"
+    c.cluster.obs_collector = "f9"
+    assert resolve_collector(c) == "f9"
+    f = Config()
+    f.name = "f1"
+    f.cluster.role = "frontend"
+    f.cluster.device_owner = "own"
+    assert resolve_collector(f) == "own"
+
+
+# -------------------------------------------------------------- collector
+
+
+def test_offset_sample_convention_matches_stitching_correction():
+    """The sign contract between the two halves of skew honesty: the
+    collector MEASURES offsets in the same collector-minus-peer
+    convention stitched() APPLIES (`raw + offset` = collector time).
+    A peer running 0.5s AHEAD reports a wall 0.5s past the RTT
+    midpoint, so its sample must come out -0.5 — the correction that
+    pulls its spans BACK into collector time (the stitching test
+    above feeds exactly this convention: o1 ahead by `skew` gets
+    offset `-skew`). Getting the sign wrong DOUBLES the skew instead
+    of cancelling it."""
+    t0, t1 = 100.0, 100.2  # rtt midpoint 100.1 on the collector clock
+    ahead = FleetCollector._offset_sample(100.1 + 0.5, t0, t1)
+    assert abs(ahead - (-0.5)) < 1e-9
+    behind = FleetCollector._offset_sample(100.1 - 0.25, t0, t1)
+    assert abs(behind - 0.25) < 1e-9
+    # Round trip: a span stamped at peer time T maps to collector
+    # time T + offset = the true wall moment.
+    peer_stamp = 100.1 + 0.5  # "now" on the ahead-peer's clock
+    assert abs((peer_stamp + ahead) - 100.1) < 1e-9
+
+
+async def _mk_pull_rig():
+    """Collector 'a' + peer 'b' with a real BusRpc obs.pull handler."""
+    bus_a = await _mk_bus("a")
+    bus_b = await _mk_bus("b")
+    await _link(bus_a, bus_b)
+    rpc_a = BusRpc(bus_a, "a", LOG)
+    rpc_b = BusRpc(bus_b, "b", LOG)
+    member_a = Membership(bus_a, LOG, heartbeat_ms=50,
+                          down_after_ms=60_000)
+    b_snapshot = {
+        "node": "b", "wall": 0.0,
+        "slo": {"burn_rates": {"api_latency": {"5m": 0.0, "1h": 0.0}}},
+        "scenario_table": {
+            "chat_fanout": {
+                "ops": 10, "ok": 10, "errors": 0,
+                "internal_errors": 0, "timeouts": 0,
+                "availability": 1.0, "p99_ms": 5.0,
+                "burn_5m": 0.0, "burn_1h": 0.0,
+                "slo": {"availability": 0.99, "p99_ms": 2000.0},
+                "by_tier": {"modeled": {
+                    "ok": 10, "error": 0, "internal_error": 0,
+                    "timeout": 0}},
+            }
+        },
+        "cluster": {}, "devobs": {}, "breakers": {},
+    }
+
+    def on_pull(src, body):
+        if faults.fire("obs.pull"):
+            raise faults.InjectedFault("obs.pull")
+        return {**b_snapshot, "wall": time.time()}
+
+    rpc_b.register("obs.pull", on_pull)
+    store = FleetTraceStore()
+    engine = HealthRuleEngine(
+        parse_rules(["stale_after_ms=400"]), LOG
+    )
+    collector = FleetCollector(
+        rpc_a, member_a, ShardDirectory("a", ["a"]), "a",
+        lambda: {"node": "a", "wall": time.time(),
+                 "scenario_table": {
+                     "chat_fanout": {
+                         "ops": 2, "ok": 1, "errors": 1,
+                         "internal_errors": 0, "timeouts": 0,
+                         "availability": 0.5, "p99_ms": 9.0,
+                         "burn_5m": 0.0, "burn_1h": 0.0,
+                         "slo": {}, "by_tier": {}},
+                 }},
+        engine, store, LOG, pull_ms=200,
+    )
+    return {
+        "buses": (bus_a, bus_b), "collector": collector,
+        "membership": member_a, "engine": engine,
+    }
+
+
+async def test_collector_federates_merges_and_marks_stale():
+    rig = await _mk_pull_rig()
+    collector, member = rig["collector"], rig["membership"]
+    try:
+        member.note_frame("b")  # liveness via real traffic
+        await collector.pull_round()
+        assert collector.pulls_ok >= 2  # local + b
+        assert "b" in collector.snapshots
+        # NTP-midpoint offset on loopback: sub-100ms by construction.
+        assert abs(collector.offsets_s["b"]) < 0.1
+        view = collector.view()
+        assert view["nodes"]["a"]["state"] == "self"
+        assert view["nodes"]["b"]["state"] == "up"
+        assert not view["nodes"]["b"]["stale"]
+        # Counts SUM across nodes, tails take the worst (merge_tables
+        # semantics, live in the product now).
+        merged = view["slo_merged"]["chat_fanout"]
+        assert merged["ops"] == 12 and merged["ok"] == 11
+        assert merged["p99_ms"] == 9.0
+        assert merged["by_tier"]["modeled"]["ok"] == 10
+
+        # Pull failures: last-known data serves, marked stale once the
+        # feed ages past the threshold; the loop never wedges.
+        failed_before = collector.pulls_failed
+        with faults.armed_ctx("obs.pull", mode="raise"):
+            await collector.pull_round()
+        assert collector.pulls_failed == failed_before + 1
+        assert collector.snapshots["b"]["data"] is not None
+        await _drain(0.45)  # age past stale_after_ms=400
+        view = collector.view()
+        assert view["nodes"]["b"]["stale"]
+        assert view["nodes"]["b"]["data"] is not None  # last-known
+        assert ("node_stale", "b") in {
+            k for k in rig["engine"].active
+        } or rig["engine"].evaluate(view) in (WARN, CRITICAL)
+
+        # Heal: the next clean pull refreshes the feed.
+        await collector.pull_round()
+        view = collector.view()
+        assert not view["nodes"]["b"]["stale"]
+        assert rig["engine"].evaluate(view) == OK
+        console = collector.console()
+        assert console["nodes"]["b"]["state"] == "up"
+        assert console["pulls"]["ok"] == collector.pulls_ok
+    finally:
+        for bus in rig["buses"]:
+            await bus.stop()
+
+
+# ------------------------------------------------------------- bench gate
+
+
+def test_fleet_obs_gate_units():
+    """fleet_obs_overhead_regression semantics (tier-1-pinned like its
+    sibling gates, so the bench verdict cannot silently rot)."""
+    reasons, bad = bench.fleet_obs_overhead_regression(0.05, 300.0)
+    assert not bad and reasons == []
+    reasons, bad = bench.fleet_obs_overhead_regression(1.0, 300.0)
+    assert bad and "overhead" in reasons[0]
+    reasons, bad = bench.fleet_obs_overhead_regression(0.05, 1500.0)
+    assert bad and "None check" in reasons[0]
+    reasons, bad = bench.fleet_obs_overhead_regression(2.0, 2000.0)
+    assert bad and len(reasons) == 2
+
+
+# ------------------------------------------------------- 3-node smoke
+
+
+def test_fleet_obs_three_nodes_stitch_federate_alert_heal():
+    asyncio.run(asyncio.wait_for(_smoke(), timeout=240))
+
+
+async def _smoke():
+    import aiohttp
+
+    base_dir = tempfile.mkdtemp(prefix="fleet-obs-smoke-")
+    # Keep everything: the stitched-trace assertion must not depend on
+    # per-node sampling luck; the shared salt is belt-and-braces for
+    # the p-sampled path.
+    tracing = {"sample_rate": 1.0, "sample_salt": "fleet-smoke"}
+    obs = {"pull_ms": 500, "trace_capacity": 2048}
+    lg = {"enabled": True, "sessions": 20, "lifetime_mean_s": 10.0}
+    owner = bench._ClusterNode(
+        "owner", "device_owner", "owner", [], base_dir,
+        db=os.path.join(base_dir, "owner.db"),
+        heartbeat_ms=200, down_after_ms=1200,
+        obs=obs, tracing=tracing,
+    )
+    f1 = bench._ClusterNode(
+        "f1", "frontend", "owner", [], base_dir,
+        heartbeat_ms=200, down_after_ms=1200,
+        obs=obs, tracing=tracing, loadgen={**lg, "seed": 71},
+    )
+    f2 = bench._ClusterNode(
+        "f2", "frontend", "owner", [], base_dir,
+        heartbeat_ms=200, down_after_ms=1200,
+        obs=obs, tracing=tracing, loadgen={**lg, "seed": 72},
+    )
+    nodes = {n.name: n for n in (owner, f1, f2)}
+    for n in nodes.values():
+        n.spec["peers"] = [
+            f"{p.name}=127.0.0.1:{p.bus_port}"
+            for p in nodes.values() if p is not n
+        ]
+        n.spawn()
+    clients = []
+    try:
+        async with aiohttp.ClientSession() as http:
+            for n in nodes.values():
+                await n.wait_healthy(http)
+            await bench._cluster_wait_converged(
+                http, list(nodes.values())
+            )
+
+            # ---- one pinned cross-node add→matched pair ------------
+            a = await bench._WsClient("a").open(
+                http, f1.base, "fleet-smoke-alpha-01"
+            )
+            b = await bench._WsClient("b").open(
+                http, f2.base, "fleet-smoke-bravo-01"
+            )
+            clients += [a, b]
+            for c in (a, b):
+                await c.send({
+                    "matchmaker_add": {
+                        "query": "+properties.mk:fleetsmoke1",
+                        "min_count": 2, "max_count": 2,
+                        "string_properties": {"mk": "fleetsmoke1"},
+                    }
+                })
+                assert (
+                    await c.recv_until("matchmaker_ticket", 15.0)
+                ) is not None
+            for c in (a, b):
+                assert (
+                    await c.recv_until("matchmaker_matched", 25.0)
+                ) is not None, f"{c.name} never matched"
+
+            # ---- the stitched fleet trace on the collector ---------
+            tree = None
+            deadline = time.perf_counter() + 30.0
+            while tree is None and time.perf_counter() < deadline:
+                listing = await bench._console_get(
+                    http, owner, "/v2/console/fleet/traces?n=256"
+                )
+                assert listing["enabled"] and listing["is_collector"]
+                for summary in listing["traces"]:
+                    if not summary["stitched"]:
+                        continue
+                    cand = await bench._console_get(
+                        http, owner,
+                        f"/v2/console/fleet/traces/"
+                        f"{summary['trace_id']}",
+                    )
+                    names = {
+                        sp["name"] for sp in cand["spans"]
+                    }
+                    origins = {
+                        sp["originNode"] for sp in cand["spans"]
+                    }
+                    # The full chain: a frontend fragment, the owner's
+                    # bus-dispatch + pool spans, and the publish-back
+                    # delivery hop.
+                    if (
+                        len(origins) >= 2
+                        and "cluster.mm.add" in names
+                        and "matchmaker.publish_back" in names
+                        and "cluster.route" in names
+                    ):
+                        tree = cand
+                        break
+                if tree is None:
+                    await asyncio.sleep(0.5)
+            assert tree is not None, (
+                "no stitched add→matched fleet trace on the collector"
+            )
+            owner_spans = [
+                sp for sp in tree["spans"]
+                if sp["originNode"] == "owner"
+            ]
+            frontend_spans = [
+                sp for sp in tree["spans"]
+                if sp["originNode"] in ("f1", "f2")
+            ]
+            assert owner_spans and frontend_spans
+            for sp in tree["spans"]:
+                assert "clockOffsetMs" in sp  # skew shown on EVERY span
+            assert any(
+                hop["basis"] == "frame_sent" for hop in tree["hops"]
+            ), tree["hops"]
+
+            # ---- the federated fleet view --------------------------
+            fleet = None
+            deadline = time.perf_counter() + 20.0
+            while time.perf_counter() < deadline:
+                fleet = await bench._console_get(
+                    http, owner, "/v2/console/fleet"
+                )
+                fresh = {
+                    n
+                    for n, i in fleet["nodes"].items()
+                    if i["data"] is not None and not i["stale"]
+                }
+                if (
+                    {"owner", "f1", "f2"} <= fresh
+                    and fleet["slo_merged"]
+                ):
+                    break
+                await asyncio.sleep(0.5)
+            assert {"owner", "f1", "f2"} <= set(fleet["nodes"])
+            for name, info in fleet["nodes"].items():
+                assert info["data"] is not None, f"{name} never pulled"
+                assert not info["stale"], f"{name} marked stale"
+                # Every node's metric families came over obs.pull.
+                assert info["data"]["metrics"], name
+            # The merged scenario SLO table is live product surface
+            # now (frontend loadgen judges merged at the collector).
+            assert fleet["slo_merged"], "no merged scenario table"
+            assert any(
+                row["ops"] > 0 for row in fleet["slo_merged"].values()
+            )
+            assert fleet["shards"], "no shard/lease map"
+            assert fleet["status"] in ("ok", "warn", "critical")
+
+            # A frontend console answers with a pointer, not a partial
+            # fleet view.
+            f1_fleet = await bench._console_get(
+                http, f1, "/v2/console/fleet"
+            )
+            assert f1_fleet["enabled"] and not f1_fleet["is_collector"]
+            assert f1_fleet["collector"] == "owner"
+
+            # ---- SIGKILL a frontend: peer_down raises, then heals --
+            f2.kill(signal.SIGKILL)
+            alert = None
+            deadline = time.perf_counter() + 25.0
+            while alert is None and time.perf_counter() < deadline:
+                fleet = await bench._console_get(
+                    http, owner, "/v2/console/fleet"
+                )
+                for act in fleet["alerts"]["active"]:
+                    if (
+                        act["rule"] == "peer_down"
+                        and act["subject"] == "f2"
+                    ):
+                        alert = act
+                if alert is None:
+                    await asyncio.sleep(0.5)
+            assert alert is not None, "peer_down alert never raised"
+            assert alert["severity"] == "critical"
+            assert alert["healed_at"] is None
+            assert fleet["status"] == "critical"
+
+            f2.spawn()  # same name/ports: the node returns
+            healed = False
+            deadline = time.perf_counter() + 40.0
+            while not healed and time.perf_counter() < deadline:
+                fleet = await bench._console_get(
+                    http, owner, "/v2/console/fleet"
+                )
+                still_active = any(
+                    act["rule"] == "peer_down"
+                    and act["subject"] == "f2"
+                    for act in fleet["alerts"]["active"]
+                )
+                healed_events = [
+                    e
+                    for e in fleet["alerts"]["recent_events"]
+                    if e["event"] == "healed"
+                    and e["rule"] == "peer_down"
+                    and e["subject"] == "f2"
+                ]
+                healed = not still_active and bool(healed_events)
+                if not healed:
+                    await asyncio.sleep(0.5)
+            assert healed, "peer_down alert never healed"
+
+            for c in clients:
+                await c.close()
+            clients = []
+    finally:
+        for c in clients:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        for n in nodes.values():
+            n.stop()

@@ -105,6 +105,44 @@ def test_log_lines_carry_trace_ids():
     trace_api.TRACES.reset()
 
 
+def test_log_lines_carry_node_name():
+    """ISSUE 13: fleet log attribution — with a node name set
+    (server.py boot), every record carries it in json, logfmt AND the
+    stackdriver shape, next to the trace ids; explicit keys win; the
+    single-process default (no name set) adds no key."""
+    from nakama_tpu.logger import set_node_name
+
+    try:
+        # The attribution is process-global (server.py boot posture):
+        # an earlier in-suite NakamaServer construction may have left
+        # a name set — the unattributed leg needs the pristine state.
+        set_node_name("")
+        buf = io.StringIO()
+        log = Logger(level=logging.INFO, fmt="json", streams=[buf])
+        log.info("unattributed")
+        set_node_name("o1")
+        log.info("attributed")
+        log.info("explicit", node="other")
+        lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+        assert "node" not in lines[0]
+        assert lines[1]["node"] == "o1"
+        assert lines[2]["node"] == "other"
+
+        buf = io.StringIO()
+        Logger(level=logging.INFO, fmt="logfmt", streams=[buf]).info(
+            "x"
+        )
+        assert "node=o1" in buf.getvalue()
+
+        buf = io.StringIO()
+        Logger(
+            level=logging.INFO, fmt="stackdriver", streams=[buf]
+        ).warn("y")
+        assert json.loads(buf.getvalue())["node"] == "o1"
+    finally:
+        set_node_name("")
+
+
 # The full exposition contract: every metric name + label set on the
 # registry, snapshotted. An accidental rename or label drift breaks
 # dashboards and alert rules SILENTLY (scrapes still succeed) — this
@@ -126,6 +164,14 @@ GOLDEN_EXPOSITION = {
     ("nakama_cluster_party_ops", "Counter", ("op", "crossed")),
     ("nakama_cluster_peers", "Gauge", ("state",)),
     ("nakama_cluster_presence_sweeps", "Counter", ()),
+    ("nakama_cluster_rpcs", "Counter", ("op", "outcome")),
+    ("nakama_obs_fragments", "Counter", ("outcome",)),
+    ("nakama_obs_pulls", "Counter", ("outcome",)),
+    ("nakama_obs_stitched_traces", "Gauge", ()),
+    ("nakama_fleet_nodes", "Gauge", ("state",)),
+    ("nakama_fleet_clock_offset_ms", "Gauge", ("node",)),
+    ("nakama_fleet_alerts", "Gauge", ("rule", "severity")),
+    ("nakama_fleet_status", "Gauge", ()),
     ("nakama_loadgen_ops", "Counter", ("scenario", "outcome")),
     ("nakama_loadgen_sessions", "Gauge", ("tier", "state")),
     ("nakama_slo_scenario_burn_rate", "Gauge", ("scenario", "window")),
