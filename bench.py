@@ -216,9 +216,13 @@ def _mk_backend(pool, **cfg_overrides):
         max_constraints=8,
         max_intervals=2,
     )
+    row_block = cfg_overrides.pop("row_block", 256)
+    col_block = cfg_overrides.pop("col_block", 2048)
     defaults.update(cfg_overrides)
     cfg = MatchmakerConfig(**defaults)
-    backend = TpuBackend(cfg, test_logger(), row_block=256, col_block=2048)
+    backend = TpuBackend(
+        cfg, test_logger(), row_block=row_block, col_block=col_block
+    )
     return cfg, backend
 
 
@@ -1603,6 +1607,365 @@ def run_device_obs_main() -> int:
         print(
             "FAIL: device telemetry regression: "
             + "; ".join(reasons),
+            file=sys.stderr,
+            flush=True,
+        )
+    return 1 if regression else 0
+
+
+# -------------------------------------------------------------- multichip
+
+MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", 8))
+MESH_POOL = int(os.environ.get("BENCH_MESH_POOL", 8192))
+MESH_INTERVALS = int(os.environ.get("BENCH_MESH_INTERVALS", 8))
+MESH_WARMUP = int(os.environ.get("BENCH_MESH_WARMUP", 3))
+# p99 bound for the forced-host-mesh leg, as a multiple of the measured
+# single-chip 100k headline (TRACE_INTERVAL_BUDGET_MS = 20.9ms). A
+# virtual 8-way CPU mesh executes all 8 shard programs in host
+# arithmetic on the same cores (measured ~2.4s median / ~4.6s p99 at
+# the 8192-ticket pool on this box — the single-device comparison stays
+# ~35ms because its dispatch is async), so the bound is deliberately
+# loose: it exists to catch order-of-magnitude collapses (a
+# per-interval recompile sneaking in, a merge that gathers the full
+# pool), not to re-measure the chip. Override per host via env; a real
+# TPU slice should pin this down hard (the 1M/<50ms target is ~2.4x).
+MESH_P99_RATIO_MAX = float(os.environ.get("BENCH_MESH_RATIO_MAX", 300.0))
+
+
+def mesh_shard_regression(
+    parity_diff, recompiles, p99_ms, headline_p99_ms, ratio_max
+) -> tuple[list, bool]:
+    """The mesh-sharded matchmaking gate (named + tier-1-unit-tested
+    like cadence_regression, so it cannot silently rot): the 8-way mesh
+    path must (1) reproduce the single-device oracle's cohorts EXACTLY
+    — cross-shard pairings are first-class, a parity diff means the
+    gather/merge dropped candidates; (2) pay ZERO recompiles after its
+    warmup intervals — shape churn on the sharded dispatch is the
+    silent 10x; (3) keep its interval p99 under ratio_max x the
+    measured 100k single-chip headline. Returns (reasons, regression);
+    any reason must set "regression": true AND a nonzero exit."""
+    reasons = []
+    if parity_diff:
+        reasons.append(
+            f"mesh_parity_diff={parity_diff} cohorts deviate from the"
+            " single-device oracle / designed pairs"
+        )
+    if recompiles:
+        reasons.append(
+            f"mesh_recompiles_after_warmup={recompiles} (budget 0:"
+            " the sharded dispatch must be shape-stable once warm)"
+        )
+    if p99_ms > headline_p99_ms * ratio_max:
+        reasons.append(
+            f"mesh interval p99 {p99_ms:.1f}ms > {ratio_max:g}x the"
+            f" {headline_p99_ms}ms 100k headline"
+        )
+    return reasons, bool(reasons)
+
+
+def _mesh_parity_leg(n_dev, n_pairs=128):
+    """Oracle parity on DESIGNED cohorts: n_pairs two-member cohorts
+    whose only eligible partner is pinned by a unique `mk` property.
+    The halves are added in two passes so pair members sit n_pairs
+    slots apart — with a 512-slot pool over 8 devices (64-slot shards)
+    every designed pair spans shards, so the leg proves cross-shard
+    pairing, not just per-shard matching. Both backends must produce
+    EXACTLY the designed pairs."""
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+
+    def run(devs):
+        # pool arg 300 → capacity rounds to 512: 64-slot shards.
+        cfg, backend = _mk_backend(
+            300, mesh_devices=devs, row_block=64, col_block=64
+        )
+        cohorts = []
+
+        def on_matched(batch):
+            for entry_set in batch:
+                cohorts.append(
+                    frozenset(e.presence.user_id for e in entry_set)
+                )
+
+        mm = LocalMatchmaker(
+            test_logger(), cfg, backend=backend, on_matched=on_matched
+        )
+        for half in range(2):
+            for i in range(n_pairs):
+                p = MatchmakerPresence(
+                    user_id=f"p{i}-{half}", session_id=f"s{i}-{half}"
+                )
+                mm.add(
+                    [p], p.session_id, "", f"+properties.mk:v{i}",
+                    2, 2, 1, {"mk": f"v{i}"}, {},
+                )
+        for _ in range(3):
+            mm.process()
+            backend.wait_idle()
+            mm.collect_pipelined()
+        mm.store.drain()
+        mm.stop()
+        return frozenset(cohorts)
+
+    designed = frozenset(
+        frozenset({f"p{i}-0", f"p{i}-1"}) for i in range(n_pairs)
+    )
+    single = run(0)
+    mesh = run(n_dev)
+    return {
+        "pairs": n_pairs,
+        "cross_shard": n_pairs,  # by construction (halves 2 shards apart)
+        "diff": len(mesh ^ designed) + len(single ^ designed),
+    }
+
+
+def _mesh_kernel_recompiles():
+    """Post-warm recompile count scoped to the MESH-PATH kernels (the
+    sharded score + the ICI gather/merge): the contract is a
+    shape-stable sharded dispatch, judged per-kernel so unrelated
+    host-side churn (e.g. scatter flush batch sizes) can't alias into
+    the mesh verdict."""
+    from nakama_tpu.devobs import DEVOBS
+
+    return sum(
+        k["recompiles"]
+        for k in DEVOBS.kernel_stats()
+        if k["kernel"]
+        in ("matchmaker.shard_score", "matchmaker.gather_merge")
+    )
+
+
+def _mesh_measure(rng, pool, intervals, warmup, mesh_devices):
+    """One measured run of the REAL backend path (mesh_devices=0 → the
+    single-device posture, >0 → the sharded dispatch), with a
+    compile-watch snapshot taken after the warmup intervals so the mesh
+    leg can prove zero post-warmup recompiles. Same timed region as
+    measure_device: process() wall-clock, pipelined completion in the
+    untimed gap."""
+    from nakama_tpu.devobs import DEVOBS
+    from nakama_tpu.logger import test_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker
+
+    cfg, backend = _mk_backend(pool, mesh_devices=mesh_devices)
+    matched = [0]
+    mm = LocalMatchmaker(
+        test_logger(), cfg, backend=backend,
+        on_matched=lambda b: matched.__setitem__(
+            0, matched[0] + b.entry_count
+        ),
+    )
+    g0, g1, g2_saved = gc.get_threshold()
+    gc.set_threshold(g0, g1, 1_000_000)
+    fill(mm, rng, pool, f"m{mesh_devices}-w", build_ticket)
+    timings = []
+    compiles_snap = recompiles_snap = 0
+    for interval in range(warmup + intervals):
+        deficit = pool - len(mm)
+        if deficit > 0:
+            fill(
+                mm, rng, deficit, f"m{mesh_devices}-i{interval}-",
+                build_ticket,
+            )
+        t0 = time.perf_counter()
+        mm.process()
+        dt = time.perf_counter() - t0
+        if interval >= warmup:
+            timings.append(dt)
+        if os.environ.get("BENCH_VERBOSE"):
+            print(
+                f"  mesh={mesh_devices} interval {interval}:"
+                f" {dt*1000:.1f}ms",
+                file=sys.stderr,
+            )
+        backend.wait_idle()
+        mm.collect_pipelined()
+        mm.store.drain()
+        gc.collect()
+        if interval < warmup:
+            # Warmup absorbs the compile work: join the background
+            # bucket-prewarm threads here so their (expected) compiles
+            # never contend with — or misattribute into — the timed
+            # steady-state intervals. On a real TPU the prewarm is
+            # host-side compile beside device execution; on a CPU host
+            # the "device" IS these cores.
+            for t in list(getattr(backend, "_warm_threads", [])):
+                t.join(timeout=300)
+        if interval == warmup - 1:
+            # Snapshot AFTER the warmup interval's pipelined pass and
+            # prewarm joins, so warmup compiles don't book against the
+            # steady-state budget.
+            compiles_snap = DEVOBS.compiles_total
+            recompiles_snap = _mesh_kernel_recompiles()
+    mm.stop()
+    gc.set_threshold(g0, g1, g2_saved)
+    timings.sort()
+    return {
+        "p99_ms": timings[min(len(timings) - 1, int(len(timings) * 0.99))]
+        * 1000,
+        "median_ms": timings[len(timings) // 2] * 1000,
+        "matched": matched[0],
+        "compiles": DEVOBS.compiles_total - compiles_snap,
+        "recompiles": _mesh_kernel_recompiles() - recompiles_snap,
+        "gather_bytes": int(getattr(backend, "mesh_gather_bytes", 0)),
+        "gather_bytes_total": int(
+            getattr(backend, "mesh_gather_bytes_total", 0)
+        ),
+        "report": DEVOBS.report_lines(),
+    }
+
+
+def run_multichip_main() -> int:
+    """`bench.py --multichip`: the mesh-sharded matchmaking proof — the
+    REAL TpuBackend mesh path, no longer a dryrun. Self-provisions an
+    8-device virtual CPU mesh when the host exposes fewer devices (the
+    __graft_entry__.dryrun_multichip posture), then:
+    (1) pins ORACLE PARITY — designed cross-shard pairs matched
+        identically by the 8-way mesh and the single-device backend;
+    (2) measures the mesh interval p99 and emits it under the
+        matchmaker_process_p99_ms_1M contract name (target_pool noted:
+        a TPU slice runs this same leg at 1M tickets, a CPU host runs
+        it at a CPU-sized pool — the leg proves the path, the chip
+        proves the scale);
+    (3) audits ZERO recompiles on the mesh path after warmup and
+        prints the per-device kernel-clock/HBM table via
+        DEVOBS.report_lines().
+    Verdict rides the named, tier-1-unit-tested mesh_shard_regression
+    in the single bench_all_metrics tail line + the exit code."""
+    import jax
+
+    n_dev = MESH_DEVICES
+    if os.environ.get("BENCH_MULTICHIP_CHILD"):
+        # The image may pin a non-CPU platform; the live config API
+        # wins as long as the backend isn't initialised yet.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", n_dev)
+        except Exception:
+            pass
+    if len(jax.devices()) < n_dev:
+        if os.environ.get("BENCH_MULTICHIP_CHILD"):
+            print(
+                f"FAIL: multichip child sees {len(jax.devices())} <"
+                f" {n_dev} devices",
+                file=sys.stderr,
+                flush=True,
+            )
+            return 1
+        # Not enough devices in-process — re-exec with a virtual
+        # n-device CPU platform. Hosts already exposing >= n real
+        # devices never get downgraded to the virtual mesh.
+        import subprocess
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+        env["BENCH_MULTICHIP_CHILD"] = "1"
+        here = os.path.abspath(__file__)
+        proc = subprocess.run(
+            [sys.executable, here, "--multichip"],
+            env=env,
+            cwd=os.path.dirname(here),
+        )
+        return proc.returncode
+
+    import numpy as np
+
+    all_metrics: dict[str, dict] = {}
+
+    def emit_json(obj):
+        print(json.dumps(obj), flush=True)
+        all_metrics[obj["metric"]] = obj
+
+    parity = _mesh_parity_leg(n_dev)
+    emit_json(
+        {
+            "metric": "mesh_parity_diff",
+            "value": parity["diff"],
+            "unit": "cohorts deviating from the designed pairs",
+            "pairs": parity["pairs"],
+            "cross_shard_pairs": parity["cross_shard"],
+            "note": (
+                "designed two-member cohorts pinned by unique `mk`"
+                " properties, halves added 2 shards apart: the 8-way"
+                " mesh backend and the single-device oracle must both"
+                " produce exactly the designed pairs — cross-shard"
+                " pairings are first-class"
+            ),
+        }
+    )
+    rng = np.random.default_rng(42)
+    single = _mesh_measure(rng, MESH_POOL, MESH_INTERVALS, MESH_WARMUP, 0)
+    rng = np.random.default_rng(42)
+    mesh = _mesh_measure(
+        rng, MESH_POOL, MESH_INTERVALS, MESH_WARMUP, n_dev
+    )
+    for line in mesh["report"]:
+        print(line, file=sys.stderr, flush=True)
+    emit_json(
+        {
+            "metric": "matchmaker_process_p99_ms_1M",
+            "value": round(mesh["p99_ms"], 2),
+            "unit": "ms",
+            "pool": MESH_POOL,
+            "target_pool": 1_000_000,
+            "devices": n_dev,
+            "median_ms": round(mesh["median_ms"], 2),
+            "single_device_p99_ms": round(single["p99_ms"], 2),
+            "matched_entries": mesh["matched"],
+            "gather_bytes_per_interval": mesh["gather_bytes"],
+            "note": (
+                "the 1M-ticket contract leg: pool columns sharded over"
+                f" the {n_dev}-device `pool` mesh axis, per-shard"
+                " masked-cosine scoring, ICI all_gather + on-device"
+                " K-way merge, global greedy assignment; on a TPU"
+                " slice this runs at target_pool (<50ms p99), a CPU"
+                " host forces the virtual mesh at a CPU-sized pool"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "mesh_recompiles_after_warmup",
+            "value": mesh["recompiles"],
+            "unit": "recompiles",
+            "compiles_after_warmup": mesh["compiles"],
+            "note": (
+                "compile watch across the steady-state mesh intervals,"
+                " scoped to the shard_score/gather_merge kernels:"
+                " nonzero means shape churn re-entered the sharded"
+                " dispatch (compiles_after_warmup is the process-wide"
+                " count for context)"
+            ),
+        }
+    )
+    reasons, regression = mesh_shard_regression(
+        parity["diff"],
+        mesh["recompiles"],
+        mesh["p99_ms"],
+        TRACE_INTERVAL_BUDGET_MS,
+        MESH_P99_RATIO_MAX,
+    )
+    emit_json(
+        {
+            "metric": "mesh_shard_regression",
+            "value": int(regression),
+            "unit": "bool",
+            "regression": regression,
+            "reasons": reasons,
+        }
+    )
+    print(
+        json.dumps(
+            {"metric": "bench_all_metrics", "metrics": all_metrics}
+        ),
+        flush=True,
+    )
+    if regression:
+        print(
+            "FAIL: mesh shard regression: " + "; ".join(reasons),
             file=sys.stderr,
             flush=True,
         )
@@ -4998,6 +5361,14 @@ def main():
         # writes its verdict into the same single bench_all_metrics
         # tail line a driver keeps.
         return run_overload_main()
+    if "--multichip" in sys.argv[1:] or os.environ.get(
+        "BENCH_MULTICHIP"
+    ):
+        # Mesh-sharded matchmaking run: the REAL multi-device interval
+        # path (pool-sharded scoring + ICI gather/merge + global greedy
+        # assignment) with oracle parity and a recompile audit, gated
+        # by the named mesh_shard_regression — no longer a dryrun.
+        return run_multichip_main()
     if "--device-obs" in sys.argv[1:] or os.environ.get(
         "BENCH_DEVICE_OBS"
     ):

@@ -130,8 +130,17 @@ class MatchmakerConfig:
     # Shard the pool's column axis over this many devices (0 = single
     # device; -1 = all visible devices). Per-interval merge rides ICI
     # collectives (SURVEY §2.8); capacity must split into col_block-sized
-    # shards.
+    # shards. Operators set the `parallel` section instead — boot
+    # resolves it onto these three mesh_* knobs (apply_parallel), which
+    # stay the backend-level mechanism (and the test surface).
     mesh_devices: int = 0
+    # Mesh axis name the pool's column shards partition over.
+    mesh_axis: str = "pool"
+    # Per-shard top-K width gathered over ICI before the global merge
+    # (0 = candidates_per_ticket, the exact merge). Widths below K are
+    # an approximate bandwidth-saving mode; the merge stays exact while
+    # gather_k >= candidates_per_ticket.
+    mesh_gather_k: int = 0
     # Pipelined intervals — THE SHIPPED DEFAULT: process() dispatches the
     # current interval's device pass and collects completed earlier ones,
     # hiding device+transfer latency entirely (100k-pool Process p99 is
@@ -406,6 +415,33 @@ class DevObsConfig:
 
 
 @dataclass
+class ParallelConfig:
+    """Mesh-sharded matchmaking (parallel/mesh.py): the pool's column
+    (candidate) axis shards over a device mesh, every device scores all
+    active rows against its shard, and per-shard top-K merges over ICI
+    into the global candidate lists (SURVEY §2.8). Boot resolves this
+    section onto matchmaker.mesh_* (apply_parallel); the single-device
+    path stays the oracle/fallback behind the mesh breaker."""
+
+    enabled: bool = False
+    # Devices to shard over: -1 = all visible, otherwise an exact count
+    # (check() refuses more than the host exposes). Must divide
+    # matchmaker.pool_capacity into col_block-sized shards.
+    n_devices: int = -1
+    # Mesh axis name; the pool arrays' NamedSharding partitions on it.
+    axis: str = "pool"
+    # Per-shard top-K width gathered over ICI before the global merge
+    # (0 = candidates_per_ticket). Must be a power of two; widths below
+    # candidates_per_ticket trade merge exactness for gather bandwidth.
+    gather_k: int = 0
+    # Pools with capacity below this stay single-device even when
+    # enabled: the gather/merge overhead only pays for itself once the
+    # per-device O(N^2/D) saving beats the collective (boot logs the
+    # refusal instead of silently sharding a toy pool).
+    min_pool_for_mesh: int = 0
+
+
+@dataclass
 class RecoveryConfig:
     """Crash-recovery plane (recovery.py): the durable ticket journal
     (append-only, LSN-ordered, drained through the group-commit write
@@ -595,6 +631,7 @@ class Config:
     tracing: TracingConfig = field(default_factory=TracingConfig)
     recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
     devobs: DevObsConfig = field(default_factory=DevObsConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     loadgen: LoadgenConfig = field(default_factory=LoadgenConfig)
 
@@ -790,6 +827,63 @@ class Config:
             warnings.append("tracing.slo_target should be in (0, 1)")
         if self.devobs.warmup_intervals < 0:
             raise ValueError("devobs.warmup_intervals must be >= 0")
+        pl = self.parallel
+        if pl.enabled:
+            if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", pl.axis or ""):
+                raise ValueError(
+                    "parallel.axis must be a mesh-axis identifier"
+                    " ([A-Za-z_][A-Za-z0-9_]*)"
+                )
+            if pl.n_devices == 0 or pl.n_devices < -1:
+                raise ValueError(
+                    "parallel.n_devices must be -1 (all visible) or a"
+                    " positive device count"
+                )
+            if pl.gather_k < 0 or (
+                pl.gather_k and pl.gather_k & (pl.gather_k - 1)
+            ):
+                raise ValueError(
+                    "parallel.gather_k must be 0 (= candidates_per_"
+                    "ticket) or a power of two — the gathered merge"
+                    " width is a compile shape, and non-pow2 widths"
+                    " churn it"
+                )
+            if pl.min_pool_for_mesh < 0:
+                raise ValueError("parallel.min_pool_for_mesh must be >= 0")
+            if not self.matchmaker.interval_pipelining:
+                raise ValueError(
+                    "parallel.enabled requires matchmaker.interval_"
+                    "pipelining: the mesh path's gather/merge rides the"
+                    " pipelined gap — synchronous intervals would put"
+                    " the ICI collective on the critical path"
+                )
+            if pl.n_devices > 0:
+                try:
+                    import jax as _jax
+
+                    visible = len(_jax.devices())
+                except Exception:
+                    visible = None
+                    warnings.append(
+                        "parallel.n_devices could not be validated"
+                        " against visible devices (jax unavailable)"
+                    )
+                if visible is not None and pl.n_devices > visible:
+                    raise ValueError(
+                        f"parallel.n_devices={pl.n_devices} but only"
+                        f" {visible} devices visible"
+                    )
+            if (
+                pl.min_pool_for_mesh
+                and self.matchmaker.pool_capacity < pl.min_pool_for_mesh
+            ):
+                warnings.append(
+                    "parallel.enabled but matchmaker.pool_capacity"
+                    f" {self.matchmaker.pool_capacity} is below"
+                    f" parallel.min_pool_for_mesh"
+                    f" {pl.min_pool_for_mesh} — the matchmaker stays"
+                    " single-device"
+                )
         lg = self.loadgen
         if lg.enabled:
             if lg.sessions < 1:
@@ -1004,6 +1098,30 @@ def config_to_dict(cfg: Any, redact: bool = False) -> dict:
     return out
 
 
+def apply_parallel(cfg: "Config") -> str | None:
+    """Resolve the operator-facing `parallel` section onto the backend-
+    level matchmaker.mesh_* knobs (the seam TpuBackend actually reads).
+    Returns a human-readable note when the mesh is refused despite
+    parallel.enabled (boot logs it), else None. Idempotent; a config
+    with parallel.enabled=False leaves mesh_devices untouched so the
+    legacy knob keeps working for tests and labs."""
+    pl = cfg.parallel
+    mm = cfg.matchmaker
+    if not pl.enabled:
+        return None
+    mm.mesh_axis = pl.axis
+    mm.mesh_gather_k = pl.gather_k
+    if pl.min_pool_for_mesh and mm.pool_capacity < pl.min_pool_for_mesh:
+        mm.mesh_devices = 0
+        return (
+            f"pool_capacity {mm.pool_capacity} below parallel."
+            f"min_pool_for_mesh {pl.min_pool_for_mesh} — staying"
+            " single-device"
+        )
+    mm.mesh_devices = pl.n_devices
+    return None
+
+
 __all__ = [
     "Config",
     "LoggerConfig",
@@ -1023,7 +1141,9 @@ __all__ = [
     "TracingConfig",
     "RecoveryConfig",
     "DevObsConfig",
+    "ParallelConfig",
     "ClusterConfig",
+    "apply_parallel",
     "load_config",
     "parse_args",
     "config_to_dict",

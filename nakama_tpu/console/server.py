@@ -908,6 +908,10 @@ class ConsoleServer:
                 "mesh": describe_mesh(
                     mesh,
                     pool_capacity=getattr(pool, "capacity", 0),
+                    pool=getattr(pool, "device", None),
+                    gather_bytes=getattr(
+                        backend, "mesh_gather_bytes", 0
+                    ),
                 ),
                 "timeline": DEVOBS.recent_timeline(n),
             }

@@ -642,6 +642,16 @@ const TABS = {
        <td>${esc(k.p50_ms)}</td><td>${esc(k.p99_ms)}</td>
        <td>${esc(k.ema_ms)}</td><td>${esc(k.compiles)}</td>
        <td>${esc(k.recompiles)}</td></tr>`).join('');
+    // Per-shard occupancy rows when the pool mesh is live: which
+    // device holds how many tickets (and how much HBM) at a glance.
+    const shards = ((d.mesh || {}).mesh || {}).shards || [];
+    const shardTable = shards.length ?
+      `<h4>mesh shards</h4>
+      <table><tr><th>device</th><th>slots</th><th>occupied</th>
+      <th>hbm_bytes</th></tr>` + shards.map(s =>
+        `<tr><td>${esc(s.device)}</td><td>${esc(s.slots)}</td>
+         <td>${esc(s.occupied)}</td><td>${esc(s.hbm_bytes)}</td>
+         </tr>`).join('') + `</table>` : '';
     el.appendChild($(`<div class="bar">
         <button id="cap">Capture 1s profile</button><span id="r"></span>
       </div>
@@ -651,6 +661,7 @@ const TABS = {
       </tr>${rows}</table>
       <h4>memory by owner</h4>${jpre(d.memory || {})}
       <h4>transfers</h4>${jpre(d.transfers || [])}
+      ${shardTable}
       <h4>mesh</h4>${jpre(d.mesh || {})}
       <h4>timeline</h4>${jpre(d.timeline || [])}`));
     el.querySelector('#cap').onclick = report(

@@ -46,6 +46,7 @@ import time
 FAULT_POINTS = (
     "device.dispatch",   # TpuBackend._dispatch (raise/stall)
     "device.collect",    # the cohort's gap-side fetch/assembly worker
+    "mesh.gather",       # sharded dispatch, pre-merge ICI gather (tpu.py)
     "db.drain",          # WriteBatcher drain loop, per batch
     "db.read",           # ReadCoalescer drain worker, per chunk
     "pg.commit",         # PG group commit, pre-COMMIT (connection loss)

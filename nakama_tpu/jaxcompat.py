@@ -1,0 +1,66 @@
+"""Version-tolerant shims over jax's sharding API.
+
+The mesh-sharded matchmaker path spans two jax generations: newer
+releases expose ``jax.shard_map`` with varying-axis (vma) typing and
+``jax.lax.pcast``; the 0.4.x line ships ``jax.experimental.shard_map``
+with replication-rule checking and no varying types at all. The shims
+here pick whichever the interpreter offers so the SAME kernel code is
+the shipped path on both — the CPU test mesh (8 virtual host devices)
+and the real chip must run identical dispatch code, not an
+if-version fork inside the kernels.
+
+Imports only jax: safe for both ``matchmaker.device*`` and
+``parallel.mesh`` (which import each other's package) to depend on.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def has_varying_types() -> bool:
+    """True when this jax tracks varying-axis (vma) types through
+    shard_map — the newer API generation."""
+    return hasattr(jax.lax, "pcast")
+
+
+def pvary(x, axis):
+    """Mark `x` (array or pytree) varying over mesh axis/axes `axis`
+    inside a shard_map body. Identity on jax generations without
+    varying-axis types (their shard_map needs no such annotation)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    return pcast(x, axes, to="varying")
+
+
+def vma_struct(shape, dtype, vma):
+    """ShapeDtypeStruct carrying vma where supported; plain otherwise
+    (pre-vma shard_map does not type outputs by varying axes)."""
+    if has_varying_types() and vma is not None:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check=True):
+    """``jax.shard_map`` when available (vma checking controlled by
+    `check`), else ``jax.experimental.shard_map.shard_map`` with
+    replication checking off — the old checker cannot see through
+    pallas_call or collective-free merges and rejects valid programs
+    the vma checker accepts."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as sm_old
+
+    return sm_old(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+__all__ = ["has_varying_types", "pvary", "vma_struct", "shard_map"]

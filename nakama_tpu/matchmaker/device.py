@@ -168,12 +168,10 @@ class PoolBuffer:
             self._scatter = _scatter
             self._invalidate = _invalidate
         # HBM ledger: the pool columns are the process's largest
-        # device-resident allocation — one owner row, refreshed on
-        # load() (capacity is fixed, so alloc time is the whole story).
-        DEVOBS.mem_set(
-            "matchmaker.pool",
-            sum(int(v.nbytes) for v in self.device.values()),
-        )
+        # device-resident allocation — one owner row (plus a per-device
+        # row each when sharded over a mesh), refreshed on load()
+        # (capacity is fixed, so alloc time is the whole story).
+        self._ledger_pool_bytes()
         # Slot allocation lives in the caller's SlotStore (store.py) so
         # host metadata, reverse maps, and device rows share one slot
         # space; this buffer only stages device-row updates by slot.
@@ -197,6 +195,23 @@ class PoolBuffer:
         self._pending_rm: list[np.ndarray] = []
         self._pending_rm_n = 0
         self.store = None  # SlotStore, bound by the backend at attach
+
+    def _ledger_pool_bytes(self):
+        """Refresh the pool's HBM ledger rows: the process-wide total,
+        and — when the slot axis shards over a mesh — one row per mesh
+        device so "which chip holds how much pool" is a ledger read."""
+        total = sum(int(v.nbytes) for v in self.device.values())
+        DEVOBS.mem_set("matchmaker.pool", total)
+        if self.sharding is None:
+            return
+        try:
+            devs = list(self.sharding.mesh.devices.flat)
+        except Exception:
+            return
+        for d in devs:
+            DEVOBS.mem_set(
+                f"matchmaker.pool.dev{d.id}", total // len(devs)
+            )
 
     def __len__(self) -> int:
         return len(self.store) if self.store is not None else 0
@@ -282,7 +297,7 @@ class PoolBuffer:
             self.device = jax.tree.map(jnp.asarray, host)
         total = sum(int(v.nbytes) for v in self.device.values())
         DEVOBS.transfer("pool.load", "h2d", total)
-        DEVOBS.mem_set("matchmaker.pool", total)
+        self._ledger_pool_bytes()
         self.high_water = hw
         # Staging state resets with the buffers it described.
         self._stage_slots[:] = -1
@@ -570,7 +585,9 @@ def scan_columns(
         jnp.full((br, k), -1, dtype=jnp.int32),
     )
     if varying_axis is not None:
-        init = jax.lax.pcast(init, (varying_axis,), to="varying")
+        from ..jaxcompat import pvary
+
+        init = pvary(init, varying_axis)
     (best_s, best_i), _ = jax.lax.scan(
         col_step, init, jnp.arange(n_col_blocks)
     )

@@ -44,6 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .device import FLAG_NEVER, FLAG_VALID
 from .device import _accepts  # exact per-field predicate (block form)
+from ..jaxcompat import pvary, shard_map, vma_struct
 
 NUM_BUCKETS = 16  # per numeric field
 STR_BUCKETS = 8  # per string field
@@ -316,11 +317,7 @@ def _stage1_call(
         out_specs=pl.BlockSpec(
             (bm, out_w), lambda i, j: (i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=(
-            jax.ShapeDtypeStruct((a_pad, out_w), jnp.int32)
-            if vma is None
-            else jax.ShapeDtypeStruct((a_pad, out_w), jnp.int32, vma=vma)
-        ),
+        out_shape=vma_struct((a_pad, out_w), jnp.int32, vma),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=2 * a_pad * n * (d + (de if with_embedding else 0)),
@@ -512,9 +509,8 @@ def topk_candidates_big_sharded(
                    row_slot, ue, uv, grid_lo, grid_inv):
         # Replicated row-side inputs meet device-varying column data in
         # the kernel: mark them varying explicitly (vma typing).
-        (uq, row_mix, row_slot, ue, uv, grid_lo, grid_inv) = jax.lax.pcast(
-            (uq, row_mix, row_slot, ue, uv, grid_lo, grid_inv), axis,
-            to="varying",
+        (uq, row_mix, row_slot, ue, uv, grid_lo, grid_inv) = pvary(
+            (uq, row_mix, row_slot, ue, uv, grid_lo, grid_inv), axis
         )
         nloc = pool_local["num"].shape[0]
         vv_l = _value_vectors(pool_local, nloc, fn, fs, grid_lo, grid_inv)
@@ -523,15 +519,11 @@ def topk_candidates_big_sharded(
                 pool_local, fn, fs, grid_lo, grid_inv, with_counts=False
             )
         else:
-            vq_l = jax.lax.pcast(
-                jnp.zeros((nloc, 8), jnp.bfloat16), axis, to="varying"
-            )
+            vq_l = pvary(jnp.zeros((nloc, 8), jnp.bfloat16), axis)
         if with_embedding:
             ve_l = pool_local["emb"].astype(jnp.bfloat16)
         else:
-            ve_l = jax.lax.pcast(
-                jnp.zeros((nloc, 8), jnp.bfloat16), axis, to="varying"
-            )
+            ve_l = pvary(jnp.zeros((nloc, 8), jnp.bfloat16), axis)
         win = _stage1_call(
             uq, vv_l, col_mix_l, col_gidx_l, row_mix, row_slot, ue,
             ve_l, uv, vq_l,
@@ -552,7 +544,7 @@ def topk_candidates_big_sharded(
 
     if with_embedding:
         pool_cols["emb"] = pool["emb"]
-    winners = jax.shard_map(
+    winners = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(
@@ -562,9 +554,9 @@ def topk_candidates_big_sharded(
         out_specs=P(axis),
         # Pallas interpret mode (CPU tests) lifts kernel-body scalar
         # constants with empty vma and the checker rejects the mix — the
-        # error text itself prescribes check_vma=False as the workaround.
-        # Real Mosaic lowering (TPU) keeps the check on.
-        check_vma=not interpret,
+        # error text itself prescribes disabling the check as the
+        # workaround. Real Mosaic lowering (TPU) keeps the check on.
+        check=not interpret,
     )(
         pool_cols, col_mix, col_gidx, uq, row_mix, row_slot, ue, uv,
         grid_lo, grid_inv,

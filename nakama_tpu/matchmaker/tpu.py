@@ -146,6 +146,11 @@ class TpuBackend:
         # runs the blockwise kernel per shard and merges over ICI
         # (SURVEY §2.8; parallel/mesh.py). Opt-in via config.mesh_devices.
         self._mesh = None
+        # Operators drive these via the config `parallel` section, which
+        # boot resolves onto the matchmaker config (config.apply_parallel);
+        # getattr defaults keep direct-construction callers working.
+        self._mesh_axis = getattr(config, "mesh_axis", "pool") or "pool"
+        self._mesh_gather_k = getattr(config, "mesh_gather_k", 0)
         mesh_n = getattr(config, "mesh_devices", 0)
         if mesh_n:
             n_dev = len(jax.devices()) if mesh_n < 0 else mesh_n
@@ -170,13 +175,15 @@ class TpuBackend:
                 )
             from ..parallel.mesh import make_mesh
 
-            self._mesh = make_mesh(n_dev)
+            self._mesh = make_mesh(n_dev, axis=self._mesh_axis)
 
         sharding = None
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            sharding = NamedSharding(self._mesh, PartitionSpec("pool"))
+            sharding = NamedSharding(
+                self._mesh, PartitionSpec(self._mesh_axis)
+            )
         self.pool = PoolBuffer(
             cap, self.fn, self.fs, self.s, self.d,
             on_flush=self._observe_chunk,
@@ -286,6 +293,21 @@ class TpuBackend:
             ),
             on_transition=self._on_breaker_transition,
         )
+        # Mesh rung of the ladder: when the SHARDED dispatch fails, this
+        # breaker routes intervals through the single-device body (the
+        # oracle path — same kernels, no shard_map) instead of wedging;
+        # the main breaker below it still guards device work as a whole,
+        # so a dead device degrades mesh → single-device → host oracle.
+        self.mesh_breaker = CircuitBreaker(
+            threshold=getattr(config, "breaker_threshold", 3),
+            cooldown_s=(
+                getattr(config, "breaker_cooldown_ms", 30_000) / 1000.0
+            ),
+            on_transition=self._on_mesh_breaker_transition,
+        )
+        # ICI gather accounting for the sharded merge (console + gauge).
+        self.mesh_gather_bytes = 0  # last dispatch's gathered bytes
+        self.mesh_gather_bytes_total = 0
         self.inflight_reclaimed = 0  # ledger total (tests/console)
         self._sweep_tick = 0  # gates the O(capacity) orphan scan
         # Cohort-completion signal (event-driven delivery): called from
@@ -309,13 +331,26 @@ class TpuBackend:
         # backend drives. Registration installs the process-wide
         # compile-watch listener (jax is imported by now), so every
         # XLA compile from here on is attributed and counted.
-        for kernel in (
+        kernels = [
             "matchmaker.scatter",
             "matchmaker.score",
             "matchmaker.assign",
             "matchmaker.fetch",
-        ):
+        ]
+        if self._mesh is not None:
+            # The sharded interval splits scoring into two named entry
+            # points so compile-watch attributes per-shard scan vs
+            # gather+merge separately.
+            kernels += ["matchmaker.shard_score", "matchmaker.gather_merge"]
+        for kernel in kernels:
             DEVOBS.register(kernel)
+        if self.metrics is not None and self._mesh is not None:
+            n_dev = self._mesh.shape[self._mesh_axis]
+            self.metrics.mesh_devices.set(n_dev)
+            for d in self._mesh.devices.flat:
+                self.metrics.mesh_shard_slots.labels(
+                    device=str(d.id)
+                ).set(cap // n_dev)
 
     def attach(self, store):
         """Bind the LocalMatchmaker's SlotStore: one slot space shared by
@@ -561,6 +596,46 @@ class TpuBackend:
             kind=kind,
             error=str(exc),
             breaker=self.breaker.state,
+        )
+
+    def _on_mesh_breaker_transition(self, old: str, new: str, reason: str):
+        self.tracing.record_breaker(
+            kind="matchmaker_mesh", old=old, new=new, reason=reason
+        )
+        log = self.logger.warn if new == "open" else self.logger.info
+        log(
+            "matchmaker mesh breaker transition",
+            old=old,
+            new=new,
+            reason=reason,
+            cooldown_s=round(self.mesh_breaker.cooldown_s, 3),
+        )
+
+    def _note_mesh_failure(self, stage: str, exc: Exception):
+        """One sharded-dispatch failure: count it on the MESH breaker
+        only — the interval immediately retries on the single-device
+        body, so the main breaker (whose open routes to the host
+        oracle) judges that retry's outcome, not this one's."""
+        kind = classify_exception(exc)
+        self.mesh_breaker.record_failure(fatal=(kind == "fatal"))
+        trace_api.add_event(
+            "breaker",
+            stage=f"mesh_{stage}",
+            kind=kind,
+            error=str(exc),
+            state=self.mesh_breaker.state,
+        )
+        if self.metrics is not None:
+            self.metrics.mm_backend_failures.labels(
+                stage=f"mesh_{stage}", kind=kind
+            ).inc()
+        log = self.logger.error if kind == "fatal" else self.logger.warn
+        log(
+            "mesh dispatch failure, degrading to single-device",
+            stage=stage,
+            kind=kind,
+            error=str(exc),
+            breaker=self.mesh_breaker.state,
         )
 
     def _reclaim_inflight(self, slots: np.ndarray, why: str) -> int:
@@ -1538,14 +1613,25 @@ class TpuBackend:
         """Launch the device top-K for the given active slots; returns an
         opaque pending handle whose transfer AND downstream host assembly
         are already in flight on a worker thread."""
-        faults.fire("device.dispatch")  # chaos: raise/stall the dispatch
         hw = self.pool.high_water
         with_should = self._should_count > 0
         with_embedding = self._emb_count > 0
-        if self._mesh is not None:
-            return self._dispatch_sharded(
-                slots, last, rev, with_should, with_embedding
-            )
+        if self._mesh is not None and self.mesh_breaker.allow():
+            try:
+                # chaos: raise/stall the dispatch (mesh rung first)
+                faults.fire("device.dispatch")
+                handle = self._dispatch_sharded(
+                    slots, last, rev, with_should, with_embedding
+                )
+                self.mesh_breaker.record_success()
+                return handle
+            except Exception as exc:
+                # Degrade, never wedge: the mesh rung failing books on
+                # ITS breaker and the same interval falls through to the
+                # single-device body below (whose own failure is what
+                # the main breaker → host-oracle ladder judges).
+                self._note_mesh_failure("dispatch", exc)
+        faults.fire("device.dispatch")  # chaos: raise/stall the dispatch
         big = hw >= self.config.big_pool_threshold
 
         if big:
@@ -1891,21 +1977,31 @@ class TpuBackend:
         collection/assembly are common."""
         import jax.numpy as jnp
 
-        from ..parallel.mesh import sharded_topk_rows
+        from ..parallel.mesh import gather_width, mesh_merge_fn, mesh_score_fn
 
+        axis = self._mesh_axis
+        n_dev = self._mesh.shape[axis]
         if self.pool.high_water >= self.config.big_pool_threshold:
             from .device2 import topk_candidates_big_sharded
 
             bm, bn = self.big_row_block, self.big_col_block
             a_pad = _pow2_blocks(-(-len(slots) // bm)) * bm
             grid_lo, grid_inv = self._grid_params()
-            with DEVOBS.device_call("matchmaker.score"):
+            # The packed-winner all_gather rides inside the fused call;
+            # its stripe width is the per-shard stage-1 output.
+            n_blocks_global = self.pool.capacity // bn
+            m = max(1, -(-2 * self.k // n_blocks_global))
+            out_w = -(-(n_blocks_global // n_dev * m) // 128) * 128
+            self._account_gather(n_dev * a_pad * out_w * 4)
+            faults.fire("mesh.gather")  # chaos: fail the ICI merge
+            with DEVOBS.device_call("matchmaker.shard_score"):
                 cand_dev = topk_candidates_big_sharded(
                     self.pool.device,
                     pad_to(slots, a_pad, -1),
                     grid_lo,
                     grid_inv,
                     mesh=self._mesh,
+                    axis=axis,
                     fn=self.fn,
                     fs=self.fs,
                     k=self.k,
@@ -1931,19 +2027,112 @@ class TpuBackend:
         rows = dict(self._gather_rows(self.pool.device, safe))
         rows["_valid"] = jnp.asarray((pad_slots >= 0).astype(np.int32))
         rows["_slot"] = jnp.asarray(pad_slots.astype(np.int32))
-        with DEVOBS.device_call("matchmaker.score"):
-            scores, cand = sharded_topk_rows(
-                self._mesh,
-                self.pool.device,
-                rows,
-                k=min(self.k, self.pool.capacity),
-                br=br,
-                bc=self.col_block,
-                rev=rev,
-                with_should=with_should,
-                with_embedding=with_embedding,
+        k = min(self.k, self.pool.capacity)
+        w = gather_width(k, n_dev, self._mesh_gather_k)
+        self._prewarm_mesh_bucket(
+            a_pad, w, rev, with_should, with_embedding,
+            {rk: (rv.shape, rv.dtype) for rk, rv in rows.items()},
+        )
+        score = mesh_score_fn(
+            self._mesh, axis, w, br, self.col_block, rev,
+            with_should, with_embedding, self.pool.capacity,
+        )
+        with DEVOBS.device_call("matchmaker.shard_score"):
+            s_all, i_all = score(
+                self.pool.device, rows, jnp.int32(self._created_base)
             )
+        self._account_gather(n_dev * a_pad * w * 8)
+        faults.fire("mesh.gather")  # chaos: fail the ICI merge
+        with DEVOBS.device_call("matchmaker.gather_merge"):
+            scores, cand = mesh_merge_fn(n_dev, w, k)(s_all, i_all)
         return self._bg_asm("small", (scores, cand), slots, last, rev)
+
+    def _account_gather(self, nbytes: int):
+        """Book one sharded merge's cross-device traffic (cost model:
+        per-shard stripes x devices; the merge IS the all_gather)."""
+        self.mesh_gather_bytes = int(nbytes)
+        self.mesh_gather_bytes_total += int(nbytes)
+        if self.metrics is not None:
+            self.metrics.mesh_gather_bytes.set(nbytes)
+
+    def _prewarm_mesh_bucket(
+        self, a_pad, w, rev, with_should, with_embedding, row_shapes
+    ):
+        """Mesh twin of _prewarm_row_bucket: whenever a row bucket is
+        dispatched on the sharded path, compile every smaller bucket
+        down to one block on a background thread, so an active-count
+        collapse never eats a multi-second shard_map compile inside a
+        timed interval. The pool scratch carries the pool's REAL
+        NamedSharding — jit keys on shardings as well as shapes, so an
+        unsharded clone would warm a different cache entry than the
+        live dispatch hits."""
+        key0 = ("mesh", a_pad, w, rev, with_should, with_embedding)
+        self._warmed_buckets.add(key0)
+        sizes = []
+        half = a_pad // 2
+        while half >= self.row_block:
+            key = ("mesh", half, w, rev, with_should, with_embedding)
+            if key not in self._warmed_buckets:
+                self._warmed_buckets.add(key)
+                sizes.append(half)
+            half //= 2
+        if not sizes:
+            return
+        pool_shapes = {
+            k: (v.shape, v.dtype) for k, v in self.pool.device.items()
+        }
+        sharding = self.pool.sharding
+        mesh, axis = self._mesh, self._mesh_axis
+        n_dev = mesh.shape[axis]
+        k_top = min(self.k, self.pool.capacity)
+
+        def _warm():
+            import jax
+            import jax.numpy as jnp
+
+            from ..parallel.mesh import mesh_merge_fn, mesh_score_fn
+
+            try:
+                with DEVOBS.device_call(
+                    "matchmaker.shard_score", expect_compile=True
+                ):
+                    scratch = {
+                        k: jax.device_put(jnp.zeros(shp, dt), sharding)
+                        for k, (shp, dt) in pool_shapes.items()
+                    }
+                score = mesh_score_fn(
+                    mesh, axis, w, self.row_block, self.col_block, rev,
+                    with_should, with_embedding, self.pool.capacity,
+                )
+                merge = mesh_merge_fn(n_dev, w, k_top)
+                for size in sizes:
+                    # Fully-masked pass: zero _valid rows score nothing,
+                    # but the compile against this row bucket is real.
+                    rows = {
+                        rk: jnp.zeros((size,) + tuple(shp[1:]), dt)
+                        for rk, (shp, dt) in row_shapes.items()
+                    }
+                    with DEVOBS.device_call(
+                        "matchmaker.shard_score", expect_compile=True
+                    ):
+                        s_all, i_all = score(scratch, rows, jnp.int32(0))
+                        jax.block_until_ready((s_all, i_all))
+                    with DEVOBS.device_call(
+                        "matchmaker.gather_merge", expect_compile=True
+                    ):
+                        jax.block_until_ready(merge(s_all, i_all))
+            except Exception as e:  # best-effort: never break dispatch
+                for size in sizes:
+                    self._warmed_buckets.discard(
+                        ("mesh", size, w, rev, with_should, with_embedding)
+                    )
+                self.logger.debug(
+                    "mesh bucket prewarm failed", error=str(e)
+                )
+
+        t = threading.Thread(target=_warm, daemon=True)
+        self._warm_threads.append(t)
+        t.start()
 
     def _prewarm_row_bucket(
         self, a_pad, n_cols, rev, with_should, with_embedding, bm, bn,

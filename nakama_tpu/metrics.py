@@ -321,6 +321,24 @@ class Metrics:
             "Host<->device bytes moved, by call site and direction",
             ("site", "direction"),
         )
+        # Mesh-sharded matchmaking (parallel/mesh.py): the pool's
+        # candidate axis split over N devices, plus the per-merge ICI
+        # gather cost — the "is the mesh live and what does the
+        # collective cost" operator view.
+        self.mesh_devices = gauge(
+            "mesh_devices",
+            "Devices in the live matchmaker pool mesh (0 = single-device)",
+        )
+        self.mesh_shard_slots = gauge(
+            "mesh_shard_slots",
+            "Pool slots resident on each mesh device (column shard size)",
+            ("device",),
+        )
+        self.mesh_gather_bytes = gauge(
+            "mesh_gather_bytes",
+            "Bytes gathered across the mesh by the last top-K merge "
+            "(devices x rows x per-shard width)",
+        )
 
         # Tracing + SLO plane (tracing.py): tail-sampling decisions on
         # completed traces (kept_error / kept_slow / kept_sampled /

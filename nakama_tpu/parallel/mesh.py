@@ -16,12 +16,14 @@ over ICI, negligible next to the O(N²/D) on-device compute.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..matchmaker.device import NEG_INF, scan_columns
+from ..matchmaker.device import FLAG_VALID, NEG_INF, scan_columns
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "pool") -> Mesh:
@@ -31,11 +33,18 @@ def make_mesh(n_devices: int | None = None, axis: str = "pool") -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
-def describe_mesh(mesh: Mesh | None = None, pool_capacity: int = 0) -> dict:
+def describe_mesh(
+    mesh: Mesh | None = None,
+    pool_capacity: int = 0,
+    pool: dict | None = None,
+    gather_bytes: int = 0,
+) -> dict:
     """Operator view of the device mesh for the telemetry console
     (`/v2/console/device`): every visible device with platform/kind,
-    plus — when a mesh is live — the axis layout and the per-device
-    slot shard the pool's column axis splits into. Never raises; a
+    plus — when a mesh is live — the axis layout, the per-device slot
+    shard the pool's column axis splits into, and (given the live pool
+    arrays) each shard's occupancy + resident HBM bytes, so "which
+    device holds my tickets" is one console row. Never raises; a
     jax-less host reports devices: []."""
     try:
         import jax as _jax
@@ -61,6 +70,34 @@ def describe_mesh(mesh: Mesh | None = None, pool_capacity: int = 0) -> dict:
         n = int(np.prod(list(axes.values()))) or 1
         if pool_capacity:
             out["mesh"]["slots_per_device"] = pool_capacity // n
+        if gather_bytes:
+            out["mesh"]["gather_bytes"] = int(gather_bytes)
+        if pool is not None:
+            try:
+                flags = np.asarray(pool["flags"])
+                total_bytes = sum(
+                    int(getattr(v, "nbytes", 0)) for v in pool.values()
+                )
+                n_local = len(flags) // n
+                shards = []
+                for i, d in enumerate(mesh.devices.flat):
+                    occ = int(
+                        np.count_nonzero(
+                            flags[i * n_local : (i + 1) * n_local]
+                            & FLAG_VALID
+                        )
+                    )
+                    shards.append(
+                        {
+                            "device": d.id,
+                            "slots": n_local,
+                            "occupied": occ,
+                            "hbm_bytes": total_bytes // n,
+                        }
+                    )
+                out["mesh"]["shards"] = shards
+            except Exception:
+                pass  # console view stays best-effort
     return out
 
 
@@ -79,24 +116,28 @@ def build_row_data(pool_host: dict, active_slots: np.ndarray) -> dict:
     return rows
 
 
-def sharded_topk_rows(
+@functools.lru_cache(maxsize=None)
+def mesh_score_fn(
     mesh: Mesh,
-    pool_sharded: dict,  # [N, ...] sharded along `axis`
-    rows: dict,  # [A_pad, ...] replicated active-row data (+_valid,_slot)
-    *,
+    axis: str,
     k: int,
     br: int,
     bc: int,
     rev: bool,
     with_should: bool,
     with_embedding: bool,
-    axis: str = "pool",
+    n_total: int,
 ):
-    """Per-device blockwise top-K over the local column shard, then a global
-    merge via all_gather over ICI. Returns (scores [A_pad, k],
-    global slot ids [A_pad, k])."""
+    """Build (once per static shape tuple) the jitted per-shard scoring
+    entry point: every device runs the blockwise masked-cosine scan over
+    ITS column shard of the pool and keeps a per-shard top-k. Cached so
+    repeated intervals hit the same jit cache entry — rebuilding the
+    shard_map closure per dispatch re-traces every call, which is
+    exactly the recompile churn the compile-watch gate outlaws.
+
+    Returned callable: (pool_sharded, rows, created_base) ->
+    (s_all, i_all) of shape [D, A_pad, k], sharded on dim 0."""
     n_dev = mesh.shape[axis]
-    n_total = pool_sharded["num"].shape[0]
     n_local = n_total // n_dev
     if n_local % bc:
         raise ValueError(
@@ -104,7 +145,7 @@ def sharded_topk_rows(
             f"column block ({bc}) or tail slots would never be scanned"
         )
 
-    def per_device(pool_local, rows):
+    def per_device(pool_local, rows, created_base):
         shard = jax.lax.axis_index(axis)
         col_base0 = shard * n_local
         a_pad = rows["_slot"].shape[0]
@@ -135,6 +176,7 @@ def sharded_topk_rows(
                 with_should=with_should,
                 with_embedding=with_embedding,
                 varying_axis=axis,
+                created_base=created_base,
             )
 
         s, i = jax.lax.map(row_block, jnp.arange(n_row_blocks))
@@ -142,20 +184,77 @@ def sharded_topk_rows(
         # shard axis the caller merges OUTSIDE shard_map.
         return s.reshape(1, a_pad, k), i.reshape(1, a_pad, k)
 
-    fn = jax.shard_map(
-        per_device,
-        mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=(P(axis), P(axis)),
+    from ..jaxcompat import shard_map
+
+    return jax.jit(
+        shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P()),
+            out_specs=(P(axis), P(axis)),
+        )
     )
-    s_all, i_all = fn(pool_sharded, rows)  # [D, A_pad, k] sharded on dim 0
-    # Global merge under GSPMD: XLA inserts the all_gather over ICI here
-    # (the merge is plain jnp, so the varying-axis checker has nothing to
-    # wave through — no check_vma escape hatch needed).
-    a_pad = s_all.shape[1]
-    s_cat = jnp.moveaxis(s_all, 0, 1).reshape(a_pad, n_dev * k)
-    i_cat = jnp.moveaxis(i_all, 0, 1).reshape(a_pad, n_dev * k)
-    best_s, sel = jax.lax.top_k(s_cat, k)
-    best_i = jnp.take_along_axis(i_cat, sel, axis=1)
-    best_i = jnp.where(best_s > NEG_INF, best_i, -1)
-    return best_s, best_i
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_merge_fn(n_dev: int, gather_w: int, k: int):
+    """Build (once per width tuple) the jitted gather+merge entry point:
+    the per-shard [D, A_pad, w] partials concatenate along the shard
+    axis — GSPMD inserts the all_gather over ICI right here, the merge
+    IS the cross-shard candidate exchange — and one lax.top_k keeps the
+    global best k per row. Gathered bytes per call: D*A_pad*w*8."""
+
+    def merge(s_all, i_all):
+        a_pad = s_all.shape[1]
+        s_cat = jnp.moveaxis(s_all, 0, 1).reshape(a_pad, n_dev * gather_w)
+        i_cat = jnp.moveaxis(i_all, 0, 1).reshape(a_pad, n_dev * gather_w)
+        best_s, sel = jax.lax.top_k(s_cat, k)
+        best_i = jnp.take_along_axis(i_cat, sel, axis=1)
+        best_i = jnp.where(best_s > NEG_INF, best_i, -1)
+        return best_s, best_i
+
+    return jax.jit(merge)
+
+
+def sharded_topk_rows(
+    mesh: Mesh,
+    pool_sharded: dict,  # [N, ...] sharded along `axis`
+    rows: dict,  # [A_pad, ...] replicated active-row data (+_valid,_slot)
+    *,
+    k: int,
+    br: int,
+    bc: int,
+    rev: bool,
+    with_should: bool,
+    with_embedding: bool,
+    axis: str = "pool",
+    gather_k: int = 0,
+    created_base=0,
+):
+    """Per-device blockwise top-K over the local column shard, then a
+    global merge via all_gather over ICI. Returns (scores [A_pad, k],
+    global slot ids [A_pad, k]).
+
+    `gather_k` bounds the per-shard width gathered over ICI (0 = k, the
+    exact merge; smaller widths are an approximate bandwidth trade,
+    never below ceil(k / n_devices) so the merged pool can still fill k
+    rows). One-call convenience over the cached mesh_score_fn /
+    mesh_merge_fn pair the production dispatch drives separately (so
+    the two phases carry their own compile-watch attribution)."""
+    n_dev = mesh.shape[axis]
+    n_total = pool_sharded["num"].shape[0]
+    w = gather_width(k, n_dev, gather_k)
+    score = mesh_score_fn(
+        mesh, axis, w, br, bc, rev, with_should, with_embedding, n_total
+    )
+    s_all, i_all = score(pool_sharded, rows, jnp.int32(created_base))
+    return mesh_merge_fn(n_dev, w, k)(s_all, i_all)
+
+
+def gather_width(k: int, n_dev: int, gather_k: int = 0) -> int:
+    """Effective per-shard top-K width gathered before the merge:
+    gather_k when set (floored so n_dev shards can still fill k global
+    rows), else the exact width k."""
+    if not gather_k:
+        return k
+    return max(gather_k, -(-k // n_dev))

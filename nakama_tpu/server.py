@@ -51,6 +51,13 @@ class NakamaServer:
 
         set_node_name(node)
 
+        # Resolve the operator-facing `parallel` section onto the
+        # matchmaker config BEFORE the backend is constructed: the mesh
+        # shape is a pool-allocation decision, not a runtime toggle.
+        from .config import apply_parallel
+
+        self._parallel_note = apply_parallel(config)
+
         # Persistence (reference DbConnect, main.go:129-133): constructed
         # here, connected in start(). `database=None` builds the embedded
         # engine from config.
@@ -752,6 +759,25 @@ class NakamaServer:
                 warmup_intervals=dv.warmup_intervals,
                 timeline_depth=dv.timeline_depth,
                 capture_max_ms=dv.capture_max_ms,
+            )
+        pl = self.config.parallel
+        if pl.enabled:
+            # The mesh posture in one line (boot-log convention): an
+            # operator asking "is the pool sharded, over how many
+            # devices, at what merge width" reads it here — including
+            # the small-pool refusal, which otherwise looks identical
+            # to a silently-ignored config.
+            backend = getattr(self.matchmaker, "backend", None)
+            mesh = getattr(backend, "_mesh", None)
+            self.logger.info(
+                "mesh-sharded matchmaking enabled",
+                devices=(
+                    mesh.shape[pl.axis] if mesh is not None else 0
+                ),
+                axis=pl.axis,
+                gather_k=pl.gather_k or None,
+                min_pool_for_mesh=pl.min_pool_for_mesh or None,
+                note=self._parallel_note,
             )
         mm_cfg = self.config.matchmaker
         if mm_cfg.interval_pipelining:

@@ -10,6 +10,12 @@ Two views per run:
   published, off the tracing ledger — with event-driven collection, so
   any future delivery-gap regression names its stage from one profile
   run instead of hiding inside an end-to-end number.
+
+`--mesh` (or PROF_MESH=1) profiles the MESH-SHARDED interval instead:
+an 8-way pool-sharded backend (self-provisioned as a virtual CPU mesh
+when the host exposes fewer devices), printing the per-interval
+dispatch→shard_score→gather→merge chain plus each shard's occupancy,
+so a mesh-path regression names its stage from one run.
 """
 
 import os
@@ -19,7 +25,9 @@ import time
 
 import numpy as np
 
-POOL = int(os.environ.get("BENCH_POOL", 100_000))
+MESH = "--mesh" in sys.argv[1:] or bool(os.environ.get("PROF_MESH"))
+MESH_DEVICES = int(os.environ.get("PROF_MESH_DEVICES", 8))
+POOL = int(os.environ.get("BENCH_POOL", 8192 if MESH else 100_000))
 
 from bench import build_ticket, fill  # noqa: E402
 from nakama_tpu.devobs import DEVOBS  # noqa: E402
@@ -45,8 +53,47 @@ from nakama_tpu.matchmaker import device as dev  # noqa: E402
 from nakama_tpu import native  # noqa: E402
 
 
+def _provision_mesh(n_dev):
+    """Self-provision an n-device virtual CPU mesh for `--mesh` (the
+    __graft_entry__.dryrun_multichip posture): the live config API
+    first, else re-exec with the XLA host-platform flag. Returns a
+    child exit code when this process re-exec'd, None to run inline."""
+    import jax
+
+    if os.environ.get("PROF_MESH_CHILD"):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", n_dev)
+        except Exception:
+            pass
+    if len(jax.devices()) >= n_dev:
+        return None
+    if os.environ.get("PROF_MESH_CHILD"):
+        raise RuntimeError(
+            f"mesh child sees {len(jax.devices())} < {n_dev} devices"
+        )
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_dev}"
+    ).strip()
+    env["PROF_MESH_CHILD"] = "1"
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+        env=env,
+    ).returncode
+
+
 def main():
     import jax
+
+    if MESH:
+        rc = _provision_mesh(MESH_DEVICES)
+        if rc is not None:
+            sys.exit(rc)
 
     rng = np.random.default_rng(42)
     cap = 1 << (POOL + POOL // 2 - 1).bit_length()
@@ -57,8 +104,13 @@ def main():
         string_fields=8,
         max_constraints=8,
         max_intervals=2,
+        mesh_devices=MESH_DEVICES if MESH else 0,
     )
-    backend = TpuBackend(cfg, test_logger(), row_block=256, col_block=2048)
+    # Mesh shards are cap/n columns each; the scan block must divide one.
+    col_block = min(2048, cap // MESH_DEVICES) if MESH else 2048
+    backend = TpuBackend(
+        cfg, test_logger(), row_block=256, col_block=col_block
+    )
     # on_matched wired so the publish stage actually runs (and stamps
     # publish_lag_s on the delivery ledger).
     matched_entries = [0]
@@ -127,6 +179,7 @@ def main():
         else:
             refill_s = 0.0
         times.clear()
+        tl_before = len(DEVOBS.timeline)
         trace = os.environ.get("PROFILE_TRACE") and interval == 3
         if trace:
             jax.profiler.start_trace("/tmp/mm_trace")
@@ -165,6 +218,40 @@ def main():
                 f"→published={d.get('publish_lag_s', float('nan'))*1000:.1f}ms"
                 + (" SLIPPED" if d.get("slipped") else "")
             )
+        if MESH:
+            # Per-shard mesh chain: the sharded score + ICI gather +
+            # on-device merge stages off the kernel-clock timeline
+            # (DEVOBS.device_call wraps both in tpu._dispatch_sharded),
+            # then each shard's live occupancy.
+            chain = {
+                "matchmaker.shard_score": 0.0,
+                "matchmaker.gather_merge": 0.0,
+            }
+            for kname, _ts, ms in list(DEVOBS.timeline)[tl_before:]:
+                if kname in chain:
+                    chain[kname] += ms
+            print(
+                f"  mesh chain: dispatch={total*1000:.1f}ms "
+                f"→shard_score={chain['matchmaker.shard_score']:.1f}ms "
+                f"→gather={backend.mesh_gather_bytes:,}B "
+                f"→merge={chain['matchmaker.gather_merge']:.1f}ms "
+                f"(cumulative gather {backend.mesh_gather_bytes_total:,}B)"
+            )
+            from nakama_tpu.parallel.mesh import describe_mesh
+
+            d = describe_mesh(
+                backend._mesh,
+                backend.pool.capacity,
+                pool=backend.pool.device,
+                gather_bytes=backend.mesh_gather_bytes,
+            )
+            for row in ((d.get("mesh") or {}).get("shards") or []):
+                print(
+                    f"    shard dev{row['device']}:"
+                    f" slots={row['slots']}"
+                    f" occupied={row['occupied']}"
+                    f" hbm={row['hbm_bytes']:,}B"
+                )
 
     stats = backend.tracing.delivery_stage_stats()
     print("delivery stage stats (dispatch-relative seconds):")

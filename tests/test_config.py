@@ -179,6 +179,92 @@ def test_cluster_shard_config_check():
         cfg.check()
 
 
+def test_parallel_defaults_off():
+    cfg = Config()
+    assert cfg.parallel.enabled is False
+    assert cfg.parallel.n_devices == -1
+    assert cfg.parallel.axis == "pool"
+    assert cfg.parallel.gather_k == 0
+    assert cfg.parallel.min_pool_for_mesh == 0
+    # Off means the legacy backend knob is untouched.
+    from nakama_tpu.config import apply_parallel
+
+    assert apply_parallel(cfg) is None
+    assert cfg.matchmaker.mesh_devices == 0
+
+
+def test_parallel_check_bounds():
+    def base():
+        cfg = Config()
+        cfg.parallel.enabled = True
+        return cfg
+
+    base().check()  # defaults are valid when enabled
+    cfg = base()
+    cfg.parallel.axis = "8bad axis"
+    with pytest.raises(ValueError, match="axis"):
+        cfg.check()
+    cfg = base()
+    cfg.parallel.n_devices = 0
+    with pytest.raises(ValueError, match="n_devices"):
+        cfg.check()
+    cfg = base()
+    cfg.parallel.n_devices = -2
+    with pytest.raises(ValueError, match="n_devices"):
+        cfg.check()
+    for bad in (3, 6, -1):
+        cfg = base()
+        cfg.parallel.gather_k = bad
+        with pytest.raises(ValueError, match="gather_k"):
+            cfg.check()
+    for good in (0, 1, 2, 64):
+        cfg = base()
+        cfg.parallel.gather_k = good
+        cfg.check()
+    cfg = base()
+    cfg.parallel.min_pool_for_mesh = -1
+    with pytest.raises(ValueError, match="min_pool_for_mesh"):
+        cfg.check()
+    # The mesh path rides the pipelined gap: refuse sync intervals.
+    cfg = base()
+    cfg.matchmaker.interval_pipelining = False
+    with pytest.raises(ValueError, match="interval_pipelining"):
+        cfg.check()
+    # More devices than the host exposes is a boot-time error, not a
+    # first-dispatch surprise (conftest provisions 8 CPU devices).
+    cfg = base()
+    cfg.parallel.n_devices = 8192
+    with pytest.raises(ValueError, match="devices visible"):
+        cfg.check()
+    # Small pool + floor: warned, not fatal (boot stays single-device).
+    cfg = base()
+    cfg.parallel.min_pool_for_mesh = cfg.matchmaker.pool_capacity * 2
+    warnings = cfg.check()
+    assert any("single-device" in w for w in warnings)
+
+
+def test_apply_parallel_resolution():
+    from nakama_tpu.config import apply_parallel
+
+    cfg = Config()
+    cfg.parallel.enabled = True
+    cfg.parallel.n_devices = 4
+    cfg.parallel.axis = "shard"
+    cfg.parallel.gather_k = 16
+    assert apply_parallel(cfg) is None
+    assert cfg.matchmaker.mesh_devices == 4
+    assert cfg.matchmaker.mesh_axis == "shard"
+    assert cfg.matchmaker.mesh_gather_k == 16
+    # The occupancy floor refuses the mesh with a loggable note.
+    cfg = Config()
+    cfg.parallel.enabled = True
+    cfg.parallel.n_devices = 4
+    cfg.parallel.min_pool_for_mesh = cfg.matchmaker.pool_capacity * 2
+    note = apply_parallel(cfg)
+    assert note and "single-device" in note
+    assert cfg.matchmaker.mesh_devices == 0
+
+
 def test_parse_args_config_flag(tmp_path):
     p = tmp_path / "c.yml"
     p.write_text("name: n1\n")

@@ -293,6 +293,83 @@ def test_breaker_opens_to_host_fallback_and_probes_back():
     mm.stop()
 
 
+def test_mesh_dispatch_fault_degrades_to_single_device_same_interval():
+    """Mesh rung of the ladder: an armed device.dispatch raise on the
+    SHARDED path books on the mesh breaker and the SAME interval falls
+    through to the single-device body — degrade, never wedge. The fault
+    point fires twice in that interval (mesh rung, then single-device
+    rung), the tickets still match, and nothing strands."""
+    mm, backend, got = make_mm(pool_capacity=512, mesh_devices=8)
+    assert backend._mesh is not None
+    add(mm, mn=2, mx=2)
+    add(mm, mn=2, mx=2)
+    fired_before = faults.PLANE.fired.get("device.dispatch", 0)
+    faults.arm("device.dispatch", "raise", count=1)
+    mm.process()  # mesh rung eats the fault; single-device dispatches
+    assert faults.PLANE.fired.get("device.dispatch") == fired_before + 1
+    assert backend.mesh_breaker.consecutive_failures == 1
+    assert backend.mesh_breaker.state == "closed"  # 1 < threshold 2
+    assert backend.breaker.state == "closed"  # main rung never failed
+    settle(mm, backend)
+    mm.process()
+    settle(mm, backend)
+    mm.process()
+    assert sum(b.entry_count for b in got) == 2
+    assert census_stranded(mm, backend) == 0
+    mm.stop()
+
+
+def test_mesh_gather_fault_opens_mesh_breaker_and_heals_to_parity():
+    """Persistent mesh.gather faults open the MESH breaker (kind
+    matchmaker_mesh on the tracing ledger) while every interval keeps
+    matching on the single-device fallback; after disarm + cooldown the
+    probe closes it and the mesh path serves again — heal to parity."""
+    mm, backend, got = make_mm(
+        pool_capacity=512, mesh_devices=8, breaker_cooldown_ms=200
+    )
+    assert backend._mesh is not None
+    faults.arm("mesh.gather", "raise")
+    # Each faulted dispatch still matches on the fallback, so feed the
+    # pool fresh tickets per interval to keep the mesh rung dispatching.
+    for _ in range(2):
+        for _ in range(4):
+            add(mm)
+        mm.process()
+        settle(mm, backend)
+        mm.process()
+    assert backend.mesh_breaker.state == "open"
+    assert backend.breaker.state == "closed"
+    assert sum(b.entry_count for b in got) >= 2  # degraded, still matching
+    # Open mesh rung: intervals dispatch single-device directly, the
+    # mesh fault point is never reached, matching continues.
+    fired_before = faults.PLANE.fired.get("mesh.gather")
+    for _ in range(4):
+        add(mm)
+    mm.process()
+    settle(mm, backend)
+    mm.process()
+    assert faults.PLANE.fired.get("mesh.gather") == fired_before
+    faults.disarm()
+    time.sleep(0.25)  # past breaker_cooldown_ms
+    for _ in range(4):
+        add(mm)
+    mm.process()  # half-open probe takes the mesh path and succeeds
+    assert backend.mesh_breaker.state == "closed"
+    settle(mm, backend)
+    mm.process()
+    settle(mm, backend)
+    assert census_stranded(mm, backend) == 0
+    flips = [
+        (e["old"], e["new"])
+        for e in backend.tracing.recent_breaker_events(64)
+        if e.get("kind") == "matchmaker_mesh"
+    ]
+    assert ("closed", "open") in flips
+    assert ("open", "half_open") in flips
+    assert ("half_open", "closed") in flips
+    mm.stop()
+
+
 def test_collect_failure_reclaims_cohort():
     mm, backend, got = make_mm()
     for _ in range(6):

@@ -192,6 +192,9 @@ GOLDEN_EXPOSITION = {
     ("nakama_leaderboard_flush_lag_sec", "Histogram", ()),
     ("nakama_leaderboard_rank_batch_size", "Histogram", ()),
     ("nakama_matches_authoritative", "Gauge", ()),
+    ("nakama_mesh_devices", "Gauge", ()),
+    ("nakama_mesh_shard_slots", "Gauge", ("device",)),
+    ("nakama_mesh_gather_bytes", "Gauge", ()),
     ("nakama_matchmaker_active_tickets", "Gauge", ()),
     ("nakama_matchmaker_backend_failures", "Counter", ("stage", "kind")),
     ("nakama_matchmaker_checkpoint_lsn", "Gauge", ()),

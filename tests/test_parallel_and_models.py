@@ -323,6 +323,211 @@ def test_full_process_on_mesh_big_kernel_matches_single_device():
     assert len(matched_mesh) > 20  # the pool genuinely matched
 
 
+def _build_paired_mm(mesh_devices):
+    """A pool whose ONLY valid matches are designed pairs: each episode
+    i has a unique `mk` property value shared by exactly two players,
+    added 128 slots apart so under the 8-way mesh (512-slot pool, 64
+    slots/shard) every pair spans two shards — cross-shard pairings are
+    pinned, not incidental."""
+    from nakama_tpu.config import MatchmakerConfig
+    from nakama_tpu.logger import test_logger as quiet_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+    from nakama_tpu.matchmaker.tpu import TpuBackend
+
+    cfg = MatchmakerConfig(
+        pool_capacity=512,
+        candidates_per_ticket=16,
+        numeric_fields=8,
+        string_fields=8,
+        max_constraints=8,
+        mesh_devices=mesh_devices,
+    )
+    backend = TpuBackend(cfg, quiet_logger(), row_block=16, col_block=64)
+    matched = []
+    mm = LocalMatchmaker(
+        quiet_logger(), cfg, backend=backend,
+        on_matched=lambda sets: matched.extend(sets),
+    )
+    n_pairs = 128
+    for half in range(2):
+        for i in range(n_pairs):
+            uid = f"p{i}h{half}"
+            p = MatchmakerPresence(user_id=uid, session_id=uid)
+            mm.add(
+                [p], p.session_id, "", f"+properties.mk:v{i}",
+                2, 2, 1, {"mk": f"v{i}"}, {},
+            )
+    return mm, matched
+
+
+def test_mesh_parity_cross_shard_pairs_1_2_8_way():
+    """Seeded host-oracle parity for the sharded path at every mesh
+    width: 1-, 2-, and 8-way meshes must form the IDENTICAL matched
+    cohorts as the single-device backend on a pool whose episodes pin
+    cross-shard pairings (each unique `mk` value's two holders sit 128
+    slots — two 8-way shards — apart). Greedy assignment stays global,
+    so a pairing spanning shards is first-class, not a merge artifact."""
+    import jax
+
+    assert len(jax.devices()) >= 8, "conftest provides the 8-CPU mesh"
+
+    def cohorts(mesh_devices):
+        mm, matched = _build_paired_mm(mesh_devices)
+        if mesh_devices:
+            assert mm.backend._mesh is not None
+        for _ in range(2):
+            mm.process()
+        return sorted(
+            tuple(sorted(e.presence.user_id for e in s)) for s in matched
+        )
+
+    expect = sorted(
+        (f"p{i}h0", f"p{i}h1") for i in range(128)
+    )
+    oracle = cohorts(0)
+    assert oracle == expect, "single-device oracle missed designed pairs"
+    for n_dev in (1, 2, 8):
+        assert cohorts(n_dev) == expect, f"{n_dev}-way mesh diverged"
+
+
+def test_mesh_cross_shard_pairs_span_shards():
+    """The pinning premise itself: under the 8-way mesh the designed
+    pairs' slots land on DIFFERENT column shards (64 slots each), so
+    the parity above genuinely exercises cross-shard matching."""
+    import jax
+
+    assert len(jax.devices()) >= 8
+    from nakama_tpu.config import MatchmakerConfig
+    from nakama_tpu.logger import test_logger as quiet_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+    from nakama_tpu.matchmaker.tpu import TpuBackend
+
+    cfg = MatchmakerConfig(
+        pool_capacity=512, candidates_per_ticket=16, numeric_fields=8,
+        string_fields=8, max_constraints=8, mesh_devices=8,
+    )
+    backend = TpuBackend(cfg, quiet_logger(), row_block=16, col_block=64)
+    mm = LocalMatchmaker(quiet_logger(), cfg, backend=backend)
+    tickets = {}
+
+    def add_half(half):
+        for i in range(16):
+            uid = f"x{i}h{half}"
+            p = MatchmakerPresence(user_id=uid, session_id=uid)
+            tickets[uid] = mm.add(
+                [p], p.session_id, "", f"+properties.mk:v{i}",
+                2, 2, 1, {"mk": f"v{i}"}, {},
+            )[0]
+
+    add_half(0)
+    # Occupy the gap so the halves sit a full shard apart in slot space.
+    for j in range(100):
+        uid = f"fill{j}"
+        p = MatchmakerPresence(user_id=uid, session_id=uid)
+        mm.add([p], p.session_id, "", "+properties.mk:zz", 2, 2, 1,
+               {"mk": f"w{j}"}, {})
+    add_half(1)
+    backend.pool.flush()
+    shard = 512 // 8
+    crossing = 0
+    for i in range(16):
+        s0 = backend.pool.slot_of[tickets[f"x{i}h0"]]
+        s1 = backend.pool.slot_of[tickets[f"x{i}h1"]]
+        if s0 // shard != s1 // shard:
+            crossing += 1
+    assert crossing == 16, f"only {crossing}/16 designed pairs cross shards"
+
+
+def test_mesh_recompile_budget_pool_churn():
+    """Compile-watch gate, mesh leg: after warmup, pow2 active-count
+    churn on the SHARDED path (shard_score + gather_merge) must compile
+    nothing — the lru-cached shard_map builders (parallel/mesh.py) keep
+    jit identity stable across dispatches, and this pins that as an
+    enforced invariant rather than a docstring."""
+    import jax
+
+    from nakama_tpu.devobs import DEVOBS
+
+    assert len(jax.devices()) >= 8
+    from nakama_tpu.config import MatchmakerConfig
+    from nakama_tpu.logger import test_logger as quiet_logger
+    from nakama_tpu.matchmaker import LocalMatchmaker, MatchmakerPresence
+    from nakama_tpu.matchmaker.tpu import TpuBackend
+
+    DEVOBS.reset()
+    try:
+        cfg = MatchmakerConfig(
+            pool_capacity=512, candidates_per_ticket=8, numeric_fields=4,
+            string_fields=4, max_constraints=4, max_intervals=50,
+            mesh_devices=8, interval_pipelining=False,
+        )
+        backend = TpuBackend(
+            cfg, quiet_logger(), row_block=8, col_block=64
+        )
+        mm = LocalMatchmaker(quiet_logger(), cfg, backend=backend)
+
+        def interval(n, prefix):
+            for i in range(n):
+                sid = f"{prefix}-{i}"
+                p = MatchmakerPresence(user_id=sid, session_id=sid)
+                mm.add([p], sid, "", "*", 2, 2, 1, {}, {})
+            mm.process()
+            backend.wait_idle()
+            mm.store.drain()
+
+        warm_sizes = [3, 9, 17]  # row pads 8/16/32
+        steady_sizes = [2, 12, 6, 24, 4]  # same pads, different counts
+        DEVOBS.configure(warmup_intervals=len(warm_sizes) + 1)
+        for it, n in enumerate(warm_sizes):
+            interval(n, f"w{it}")
+        interval(0, "wdrain")
+        assert DEVOBS.warmed
+        compiles_at_warm = DEVOBS.compiles_total
+        for it, n in enumerate(steady_sizes):
+            interval(n, f"s{it}")
+        interval(0, "sdrain")
+        assert DEVOBS.recompiles_total == 0, (
+            "mesh-path churn recompiled: "
+            f"{[k for k in DEVOBS.kernel_stats() if k['recompiles']]}"
+        )
+        assert DEVOBS.compiles_total == compiles_at_warm, (
+            f"mesh steady phase compiled: {DEVOBS.compiles_total} vs"
+            f" {compiles_at_warm} at warmup close"
+        )
+        mm.stop()
+    finally:
+        DEVOBS.reset()
+
+
+def test_describe_mesh_reports_shard_occupancy_and_gather_bytes():
+    """The console satellite: given the live (sharded) pool arrays,
+    describe_mesh reports per-device slot counts, FLAG_VALID occupancy
+    and resident HBM bytes, plus the last merge's gather cost."""
+    import jax
+
+    assert len(jax.devices()) >= 8
+    from nakama_tpu.parallel.mesh import describe_mesh
+
+    backend, slots = _build_pool(n=256)
+    from nakama_tpu.parallel import make_mesh, shard_pool
+
+    mesh = make_mesh(8)
+    pool_sharded = shard_pool(backend.pool.device, mesh)
+    out = describe_mesh(
+        mesh, pool_capacity=256, pool=pool_sharded, gather_bytes=4096
+    )
+    m = out["mesh"]
+    assert m["slots_per_device"] == 32
+    assert m["gather_bytes"] == 4096
+    shards = m["shards"]
+    assert len(shards) == 8
+    assert all(s["slots"] == 32 for s in shards)
+    assert all(s["hbm_bytes"] > 0 for s in shards)
+    assert sum(s["occupied"] for s in shards) == len(slots)
+    # Hermetic on a jax-less view too: no mesh -> devices only.
+    assert describe_mesh(None)["mesh"] is None
+
+
 def test_device_pairing_runs_on_mesh():
     """Round-4 device-side 1v1 pairing under the 8-device mesh
     (VERDICT r4 #8): a synchronous pure-1v1 pool over the sharded big
@@ -371,3 +576,26 @@ def test_device_pairing_runs_on_mesh():
         assert len(entry_set) == 2
         modes = {e.string_properties["mode"] for e in entry_set}
         assert len(modes) == 1, f"pairing crossed pools: {modes}"
+
+
+def test_mesh_shard_regression_gate():
+    """The bench's mesh gate is a named pure function so tier 1 can
+    pin its tripwires (the cadence_regression convention): parity
+    drift, post-warmup recompiles, and a p99 blowout each produce a
+    named reason and regression=True; a clean run produces neither."""
+    import bench
+
+    gate = bench.mesh_shard_regression
+    reasons, bad = gate(0, 0, 100.0, 20.9, 25.0)
+    assert not bad and reasons == []
+    reasons, bad = gate(2, 0, 100.0, 20.9, 25.0)
+    assert bad and "mesh_parity_diff=2" in reasons[0]
+    reasons, bad = gate(0, 1, 100.0, 20.9, 25.0)
+    assert bad and "recompiles_after_warmup=1" in reasons[0]
+    reasons, bad = gate(0, 0, 20.9 * 25.0 + 1, 20.9, 25.0)
+    assert bad and "p99" in reasons[0]
+    # All three at once: every reason present, still one verdict.
+    reasons, bad = gate(1, 1, 10_000.0, 20.9, 25.0)
+    assert bad and len(reasons) == 3
+    # The shipped default ratio exists and is sane.
+    assert bench.MESH_P99_RATIO_MAX > 1
