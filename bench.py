@@ -3675,6 +3675,18 @@ async def _cluster_node_main():
     cfg.cluster.standby_of = spec.get("standby_of", "")
     cfg.cluster.lease_ms = spec.get("lease_ms", 2000)
     cfg.cluster.lease_grace_ms = spec.get("lease_grace_ms", 3000)
+    # Elastic resharding (PR 20): live split/merge/move migrations.
+    rs = spec.get("reshard") or {}
+    if rs.get("enabled"):
+        cfg.cluster.reshard.enabled = True
+        if rs.get("drain_threshold_lsn"):
+            cfg.cluster.reshard.drain_threshold_lsn = int(
+                rs["drain_threshold_lsn"]
+            )
+        if rs.get("handover_timeout_ms"):
+            cfg.cluster.reshard.handover_timeout_ms = int(
+                rs["handover_timeout_ms"]
+            )
     # Fleet observability (PR 13): collector designation + cadences,
     # and the fleet-shared sampling salt that lets the collector
     # stitch p-sampled traces (without it only error/slow-kept
@@ -3770,7 +3782,8 @@ class _ClusterNode:
                  heartbeat_ms=200, down_after_ms=1200,
                  shards=None, standby_of="", lease_ms=2000,
                  lease_grace_ms=3000, checkpoint_interval_sec=0,
-                 loadgen=None, arm=None, obs=None, tracing=None):
+                 loadgen=None, arm=None, obs=None, tracing=None,
+                 reshard=None):
         import tempfile
 
         self.name = name
@@ -3801,6 +3814,7 @@ class _ClusterNode:
             "arm": arm or [],
             "obs": obs or {},
             "tracing": tracing or {},
+            "reshard": reshard or {},
             "peers": peers,  # filled before spawn
         }
         self.proc = None
@@ -4933,6 +4947,510 @@ def run_failover_main() -> int:
 
 
 # --------------------------------------------------------------------------
+# Elastic resharding soak (PR 20): 6-node loopback — 2 flat owner shards
+# + 2 reserve owners + 2 frontends. Pool-keyed traffic soaks a baseline,
+# then two operator-submitted split plans run mid-soak (o1 -> o1/0+o1/1
+# with o1/1 migrating to reserve o3; then o2 likewise to o4), taking the
+# map from 2 to 4 shards with ZERO acknowledged-ticket loss, the p99
+# blip bounded (<= 2x baseline for under 2 lease periods), the planner's
+# reshard_active alert raised AND healed per executed plan, and never an
+# abort. Verdict rides the single `bench_all_metrics` tail line + rc,
+# gated by the named `reshard_regression`.
+# ---------------------------------------------------------------------------
+
+RESHARD_BLIP_RATIO_MAX = float(
+    os.environ.get("BENCH_RESHARD_BLIP_RATIO_MAX", 2.0)
+)
+
+
+def reshard_regression(
+    baseline_p99_ms,
+    blip_window_ms,
+    lease_ms,
+    lost_tickets,
+    hung,
+    generation,
+    shards_after,
+    expected_shards,
+    migrated_counts,
+    plans_executed,
+    raised,
+    healed,
+    active_alerts,
+    aborts,
+) -> tuple[list, bool]:
+    """The elastic-topology gate (named + tier-1-unit-tested like its
+    siblings): two live splits mid-soak lose ZERO acknowledged tickets,
+    end at the expected 4-shard map and generation 2, every migration
+    actually moves tickets, soak rounds whose p99 exceeds 2x the
+    pre-split baseline span under 2 lease periods, each executed plan
+    leaves exactly one raise->heal reshard_active ledger pair (none
+    still active), and nothing aborts. Returns (reasons, regression)."""
+    reasons = []
+    if lost_tickets:
+        reasons.append(f"lost_tickets={lost_tickets}")
+    if hung:
+        reasons.append(f"hung_clients={hung}")
+    if generation != plans_executed:
+        reasons.append(
+            f"map generation {generation} != {plans_executed}"
+            " executed plans"
+        )
+    if set(shards_after) != set(expected_shards):
+        reasons.append(
+            f"final map {sorted(shards_after)} !="
+            f" {sorted(expected_shards)}"
+        )
+    for target, moved in sorted(migrated_counts.items()):
+        if moved <= 0:
+            reasons.append(
+                f"migration to {target} moved zero tickets"
+            )
+    if baseline_p99_ms > 0 and blip_window_ms >= 2.0 * lease_ms:
+        reasons.append(
+            f"p99 blip window {blip_window_ms:.0f}ms >= 2 lease"
+            f" periods ({2 * lease_ms}ms)"
+        )
+    if raised < plans_executed:
+        reasons.append(
+            f"reshard_active raised {raised}x < {plans_executed} plans"
+        )
+    if healed < plans_executed:
+        reasons.append(
+            f"reshard_active healed {healed}x < {plans_executed} plans"
+        )
+    if active_alerts:
+        reasons.append(
+            f"{active_alerts} reshard_active alert(s) never healed"
+        )
+    if aborts:
+        reasons.append(f"migration aborts={aborts}")
+    return reasons, bool(reasons)
+
+
+def _reshard_pool_for(flat_shard, child, flat, post):
+    """A deterministic pool name that routes to `flat_shard` under the
+    pre-split map AND to `child` under the post-split map — the
+    sentinel keyspace that provably rides the migration."""
+    from nakama_tpu.cluster.sharding import rendezvous_shard
+
+    for i in range(10_000):
+        pool = f"rs{i}"
+        if (
+            rendezvous_shard(pool, flat) == flat_shard
+            and rendezvous_shard(pool, post) == child
+        ):
+            return pool
+    raise RuntimeError(
+        f"no pool found for {flat_shard} -> {child} in 10k candidates"
+    )
+
+
+async def _console_post(http, node, path, body):
+    """Authenticated console POST on a child node (token cached on the
+    node handle, same flow as _console_get)."""
+    token = getattr(node, "_console_token", None)
+    if token is None:
+        async with http.post(
+            f"{node.console}/v2/console/authenticate",
+            json={"username": "admin", "password": "password"},
+        ) as r:
+            assert r.status == 200, (r.status, await r.text())
+            token = (await r.json())["token"]
+        node._console_token = token
+    async with http.post(
+        f"{node.console}{path}",
+        headers={"Authorization": f"Bearer {token}"},
+        json=body,
+    ) as r:
+        assert r.status == 200, (r.status, await r.text())
+        return await r.json()
+
+
+async def _fleet_console(http, node):
+    return await _console_get(http, node, "/v2/console/fleet")
+
+
+async def _reshard_soak_round(pairs, timeout=20.0):
+    """One pool-keyed 1v1 round over every pair; returns
+    (t_start, duration_ms, latencies_ms, hung)."""
+    t0 = time.perf_counter()
+    lat, hung = await _failover_match_rounds(pairs, 1, timeout=timeout)
+    return t0, (time.perf_counter() - t0) * 1000.0, lat, hung
+
+
+async def _reshard_wait_plan(http, collector, pairs, shard, target,
+                             generation, timeout=45.0):
+    """Keep soaking while a submitted plan executes; returns the soak
+    round records + the fleet snapshot once `shard` is owned by
+    `target` at `generation` (or raises on timeout)."""
+    recs = []
+    t_end = time.perf_counter() + timeout
+    while time.perf_counter() < t_end:
+        recs.append(await _reshard_soak_round(pairs))
+        fleet = await _fleet_console(http, collector)
+        sh = (fleet.get("shards") or {}).get(shard) or {}
+        if (
+            fleet.get("generation", 0) >= generation
+            and sh.get("node") == target
+            and (fleet.get("reshard") or {}).get("active") is None
+        ):
+            return recs, fleet
+    raise RuntimeError(
+        f"reshard plan never completed: {shard} -> {target}"
+        f" @ generation {generation}"
+    )
+
+
+async def _reshard_bench_body(emit_json):
+    import tempfile
+
+    import aiohttp
+
+    base_dir = tempfile.mkdtemp(prefix="bench-reshard-")
+    rounds = int(os.environ.get("BENCH_RESHARD_ROUNDS", 6))
+    flat = ["o1", "o2"]
+    shards1 = ["o2", "o1/0", "o1/1"]          # after plan 1
+    shards2 = ["o1/0", "o1/1", "o2/0", "o2/1"]  # after plan 2
+    lease_ms, lease_grace_ms = 2000, 3000
+    pools = _failover_pools(flat)  # shard -> soak pool
+    sent_o1 = _reshard_pool_for("o1", "o1/1", flat, shards1)
+    sent_o2 = _reshard_pool_for("o2", "o2/1", flat, shards2)
+    rs = {"enabled": True, "drain_threshold_lsn": 16,
+          "handover_timeout_ms": 8000}
+    obs = {"collector": "o1", "pull_ms": 200}
+    out: dict = {
+        "lease_ms": lease_ms,
+        "pools": pools,
+        "sentinel_pools": {"o1/1": sent_o1, "o2/1": sent_o2},
+    }
+    async with aiohttp.ClientSession() as http:
+        o1 = _ClusterNode(
+            "o1", "device_owner", "", [], base_dir, shards=flat,
+            lease_ms=lease_ms, lease_grace_ms=lease_grace_ms,
+            reshard=rs, obs=obs,
+        )
+        o2 = _ClusterNode(
+            "o2", "device_owner", "", [], base_dir, shards=flat,
+            lease_ms=lease_ms, lease_grace_ms=lease_grace_ms,
+            reshard=rs, obs=obs,
+        )
+        # Reserve owners: device_owner role, zero shards owned — the
+        # planner's growth headroom (config allows the mismatch only
+        # with resharding enabled).
+        o3 = _ClusterNode(
+            "o3", "device_owner", "", [], base_dir, shards=flat,
+            lease_ms=lease_ms, lease_grace_ms=lease_grace_ms,
+            reshard=rs, obs=obs,
+        )
+        o4 = _ClusterNode(
+            "o4", "device_owner", "", [], base_dir, shards=flat,
+            lease_ms=lease_ms, lease_grace_ms=lease_grace_ms,
+            reshard=rs, obs=obs,
+        )
+        f1 = _ClusterNode(
+            "f1", "frontend", "", [], base_dir, shards=flat,
+            lease_ms=lease_ms, lease_grace_ms=lease_grace_ms,
+            reshard=rs, obs=obs,
+        )
+        f2 = _ClusterNode(
+            "f2", "frontend", "", [], base_dir, shards=flat,
+            lease_ms=lease_ms, lease_grace_ms=lease_grace_ms,
+            reshard=rs, obs=obs,
+        )
+        nodes = {n.name: n for n in (o1, o2, o3, o4, f1, f2)}
+        for n in nodes.values():
+            n.spec["peers"] = [
+                f"{p.name}=127.0.0.1:{p.bus_port}"
+                for p in nodes.values() if p is not n
+            ]
+            n.spawn()
+        clients = []
+        try:
+            for n in nodes.values():
+                await n.wait_healthy(http)
+            await _cluster_wait_converged(http, list(nodes.values()))
+            pairs = []
+            for i, pool in enumerate(sorted(pools.values())):
+                a = await _WsClient(f"ra{i}").open(
+                    http, f1.base, f"bench-rs-ra-{i:04d}xx"
+                )
+                b = await _WsClient(f"rb{i}").open(
+                    http, f2.base, f"bench-rs-rb-{i:04d}xx"
+                )
+                clients += [a, b]
+                pairs.append((a, b, pool))
+            # Sentinel tickets: never-matching adds pinned to the
+            # keyspace slices that will migrate — their survival on the
+            # new owners is the zero-loss proof. One client per slice
+            # (matchmaker.max_tickets bounds per-session adds).
+            for k, pool in enumerate((sent_o1, sent_o2)):
+                sent = await _WsClient(f"sent{k}").open(
+                    http, f1.base, f"bench-rs-sent-{k:04d}"
+                )
+                clients.append(sent)
+                for j in range(3):
+                    await sent.send({
+                        "matchmaker_add": {
+                            "query": f"+properties.never:rs{k}{j}",
+                            "min_count": 2, "max_count": 2,
+                            "string_properties": {
+                                "pool": pool, "mode": f"rs{k}{j}",
+                            },
+                        }
+                    })
+                    assert (
+                        await sent.recv_until("matchmaker_ticket", 10.0)
+                    ) is not None
+            # ---- pre-split baseline -------------------------------
+            base_lat, base_hung = [], 0
+            for _ in range(rounds):
+                _, _, lat, hung = await _reshard_soak_round(pairs)
+                base_lat += lat
+                base_hung += hung
+            out["baseline_p99_ms"] = _cluster_p99(base_lat)
+            out["baseline_hung"] = base_hung
+
+            # ---- plan 1: split o1 -> o1/0 (stays) + o1/1 (-> o3) --
+            mig_recs = []
+            t_mig0 = time.perf_counter()
+            await _console_post(
+                http, o1, "/v2/console/fleet/reshard",
+                {"kind": "split", "shard": "o1/1", "shards": shards1,
+                 "source": "o1", "target": "o3"},
+            )
+            recs, fleet = await _reshard_wait_plan(
+                http, o1, pairs, "o1/1", "o3", 1
+            )
+            mig_recs += recs
+            out["gen_after_plan1"] = fleet["generation"]
+
+            # ---- plan 2: split o2 -> o2/0 (stays) + o2/1 (-> o4) --
+            await _console_post(
+                http, o1, "/v2/console/fleet/reshard",
+                {"kind": "split", "shard": "o2/1", "shards": shards2,
+                 "source": "o2", "target": "o4"},
+            )
+            recs, fleet = await _reshard_wait_plan(
+                http, o1, pairs, "o2/1", "o4", 2
+            )
+            mig_recs += recs
+            out["migration_window_ms"] = (
+                time.perf_counter() - t_mig0
+            ) * 1000.0
+
+            # ---- post-split soak ----------------------------------
+            post_lat, post_hung = [], 0
+            for _ in range(max(2, rounds // 2)):
+                _, _, lat, hung = await _reshard_soak_round(pairs)
+                post_lat += lat
+                post_hung += hung
+            out["post_p99_ms"] = _cluster_p99(post_lat)
+
+            # ---- p99 blip: rounds above 2x baseline during the
+            # migrations, summed as wall-clock ----------------------
+            blip_ms = 0.0
+            mig_lat, mig_hung = [], 0
+            for _t0, dur_ms, lat, hung in mig_recs:
+                mig_lat += lat
+                mig_hung += hung
+                if (
+                    lat
+                    and _cluster_p99(lat)
+                    > RESHARD_BLIP_RATIO_MAX * out["baseline_p99_ms"]
+                ):
+                    blip_ms += dur_ms
+            out["mid_migration_p99_ms"] = _cluster_p99(mig_lat)
+            out["blip_window_ms"] = blip_ms
+            out["hung"] = base_hung + mig_hung + post_hung
+
+            # ---- final topology + per-node ledgers ----------------
+            fleet = await _fleet_console(http, o1)
+            out["generation"] = fleet["generation"]
+            out["shards_after"] = sorted(fleet["shards"])
+            out["expected_shards"] = sorted(shards2)
+            snap3 = await _cluster_console(http, o3)
+            snap4 = await _cluster_console(http, o4)
+            out["migrated_counts"] = {
+                "o3": (snap3.get("reshard") or {}).get(
+                    "migrated_in", 0
+                ),
+                "o4": (snap4.get("reshard") or {}).get(
+                    "migrated_in", 0
+                ),
+            }
+            aborts = 0
+            pooled = 0
+            for n in (o1, o2, o3, o4):
+                snap = await _cluster_console(http, n)
+                aborts += (snap.get("reshard") or {}).get("aborts", 0)
+                pooled += snap.get("matchmaker_tickets", 0)
+            out["aborts"] = aborts
+
+            # ---- raise->heal ledger audit -------------------------
+            events = (fleet.get("alerts") or {}).get(
+                "recent_events"
+            ) or []
+            out["raised"] = sum(
+                1 for e in events
+                if e.get("rule") == "reshard_active"
+                and e.get("event") == "raised"
+            )
+            out["healed"] = sum(
+                1 for e in events
+                if e.get("rule") == "reshard_active"
+                and e.get("event") == "healed"
+            )
+            active = (fleet.get("alerts") or {}).get("active") or []
+            out["active_reshard_alerts"] = sum(
+                1 for a in active
+                if (a.get("rule") if isinstance(a, dict) else a)
+                == "reshard_active"
+            )
+
+            # ---- zero acknowledged-ticket loss audit --------------
+            unresolved = 0
+            for c in clients:
+                if not c.acked_tickets:
+                    continue
+                unresolved += len(
+                    set(c.acked_tickets) - set(c.matched_tickets)
+                )
+            out["lost_tickets"] = max(0, unresolved - pooled)
+            out["unresolved_acked"] = unresolved
+            out["pooled_after_splits"] = pooled
+        finally:
+            for c in clients:
+                await c.close()
+            for n in nodes.values():
+                n.stop()
+    return out
+
+
+def run_reshard_main() -> int:
+    """`bench.py --reshard`: the elastic-topology proof — 2 flat owner
+    shards split live to 4 across 2 reserve owners mid-soak, audited
+    for loss/blip/raise->heal. Verdict rides the single
+    `bench_all_metrics` tail line + exit code, gated by the named
+    `reshard_regression`."""
+    import asyncio
+
+    all_metrics: dict = {}
+
+    def emit_json(obj):
+        if "metric" in obj and "value" in obj:
+            all_metrics[obj["metric"]] = obj["value"]
+        print(json.dumps(obj), flush=True)
+
+    out = asyncio.run(_reshard_bench_body(emit_json))
+    reasons, regression = reshard_regression(
+        out["baseline_p99_ms"],
+        out["blip_window_ms"],
+        out["lease_ms"],
+        out["lost_tickets"],
+        out["hung"],
+        out["generation"],
+        out["shards_after"],
+        out["expected_shards"],
+        out["migrated_counts"],
+        2,
+        out["raised"],
+        out["healed"],
+        out["active_reshard_alerts"],
+        out["aborts"],
+    )
+    emit_json(
+        {
+            "metric": "reshard_mid_migration_p99_ms",
+            "value": round(out["mid_migration_p99_ms"], 1),
+            "unit": "ms",
+            "baseline_p99_ms": round(out["baseline_p99_ms"], 1),
+            "post_split_p99_ms": round(out["post_p99_ms"], 1),
+            "blip_window_ms": round(out["blip_window_ms"], 1),
+            "blip_budget_ms": 2 * out["lease_ms"],
+            "note": (
+                "pool-keyed add->matched p99 while two live splits"
+                " execute; blip window = wall-clock of soak rounds"
+                f" whose p99 exceeded {RESHARD_BLIP_RATIO_MAX}x the"
+                " pre-split baseline (budget: 2 lease periods)"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "reshard_migration_window_ms",
+            "value": round(out["migration_window_ms"], 1),
+            "unit": "ms",
+            "generation": out["generation"],
+            "shards_after": out["shards_after"],
+            "note": (
+                "submit of the first split plan to the second split's"
+                " confirmed handover: 2 -> 4 shards, two epoch-fenced"
+                " lease handovers, zero downtime"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "reshard_migrated_tickets",
+            "value": sum(out["migrated_counts"].values()),
+            "unit": "tickets",
+            "per_target": out["migrated_counts"],
+            "aborts": out["aborts"],
+            "note": (
+                "tickets adopted by the reserve owners at handover"
+                " (sentinels pinned to the moving keyspace + live"
+                " soak tickets in flight)"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "reshard_loss_audit",
+            "value": out["lost_tickets"],
+            "unit": "lost tickets",
+            "unresolved_acked": out["unresolved_acked"],
+            "pooled_after_splits": out["pooled_after_splits"],
+            "hung_clients": out["hung"],
+            "raised": out["raised"],
+            "healed": out["healed"],
+            "note": (
+                "every acked ticket either matched or is pooled on"
+                " a current owner after both splits; reshard_active"
+                " raised+healed once per executed plan"
+            ),
+        }
+    )
+    emit_json(
+        {
+            "metric": "reshard_regression",
+            "value": regression,
+            "reasons": reasons,
+            "note": (
+                "named gate (tier-1-unit-tested): zero lost tickets,"
+                " generation 2 + the expected 4-shard map, every"
+                " migration moved tickets, p99 blip window < 2 lease"
+                " periods, one raise->heal reshard_active pair per"
+                " plan, zero aborts, no hung clients"
+            ),
+        }
+    )
+    print(
+        json.dumps(
+            {"metric": "bench_all_metrics", "metrics": all_metrics}
+        ),
+        flush=True,
+    )
+    if regression:
+        print(
+            f"FAIL: reshard regression: {'; '.join(reasons)}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return 1 if regression else 0
+
+
+# --------------------------------------------------------------------------
 # Million-session soak (PR 12): the whole product under load at once.
 # `bench.py --soak` boots a 4-node lab (owner shard + warm standby + 2
 # loadgen frontends), drives the full scenario catalog concurrently —
@@ -5312,6 +5830,15 @@ def main():
         # table — separable from the perf sampling like --cluster,
         # verdict in the same bench_all_metrics tail line.
         return run_soak_main()
+    if "--reshard" in sys.argv[1:] or os.environ.get(
+        "BENCH_RESHARD"
+    ):
+        # Elastic-topology-only run: the live split/merge proof — 6
+        # nodes on loopback, two operator-submitted splits mid-soak
+        # (2 -> 4 shards onto reserve owners), audit loss/blip/
+        # raise->heal — separable from the perf sampling like
+        # --failover, verdict in the same bench_all_metrics tail line.
+        return run_reshard_main()
     if "--failover" in sys.argv[1:] or os.environ.get(
         "BENCH_FAILOVER"
     ):

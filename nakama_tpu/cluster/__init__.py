@@ -42,6 +42,12 @@ from .ops import (
     RemotePartyHandler,
 )
 from .plane import ClusterPlane, cluster_peers_signal
+from .reshard import (
+    PlanJournal,
+    ReshardPlanner,
+    ShardMigrator,
+    plan_check,
+)
 from .presence import (
     ClusterMessageRouter,
     ClusterSessionRegistry,
@@ -49,7 +55,12 @@ from .presence import (
     ClusterTracker,
 )
 from .replication import JournalShipper, ReplicationApplier
-from .sharding import ShardDirectory, rendezvous_shard, shard_key
+from .sharding import (
+    ShardDirectory,
+    parent_shard,
+    rendezvous_shard,
+    shard_key,
+)
 
 __all__ = [
     "BusRpc",
@@ -74,14 +85,19 @@ __all__ = [
     "JournalShipper",
     "LeaseManager",
     "Membership",
+    "PlanJournal",
     "ReplicationApplier",
+    "ReshardPlanner",
     "ShardDirectory",
+    "ShardMigrator",
     "TraceFragmentExporter",
     "cluster_matched_handler",
     "cluster_peers_signal",
     "resolve_collector",
     "decode_frames",
     "encode_frame",
+    "parent_shard",
+    "plan_check",
     "rendezvous_shard",
     "shard_key",
 ]

@@ -79,6 +79,17 @@ class LeaseManager:
             return {}
         claims = []
         for shard in sorted(self.owned):
+            if shard not in self.directory.shards:
+                # A reshard map edit retired this shard id (split
+                # children replaced it, or a merge absorbed it). Not a
+                # demotion — the keyspace moved, not the lease.
+                self.owned.discard(shard)
+                self.logger.info(
+                    "owned shard left the map (reshard) — dropping",
+                    shard=shard,
+                    generation=self.directory.generation,
+                )
+                continue
             try:
                 if faults.fire("lease.renew"):
                     continue  # renewal dropped: the lease decays

@@ -156,6 +156,7 @@ class ClusterMatchmakerClient:
             300.0, 4.0 * config.interval_sec * config.max_intervals
         )
         self.directory.on_transition.append(self._on_shard_moved)
+        self.directory.on_map_change.append(self._on_map_changed)
         bus.on("mm.matched", self._on_matched)
         bus.on("mm.reject", self._on_reject)
 
@@ -550,6 +551,66 @@ class ClusterMatchmakerClient:
             tickets=len(moved), sent=sent, tombstones=len(dead),
         )
 
+    def _on_map_changed(
+        self, generation: int, old: list[str], new: list[str]
+    ):
+        """Reshard map edit observed: recompute every booked ticket's
+        shard under the NEW keyspace and re-forward the ones that
+        moved (idempotent at the receiver — the pre-minted-id guard
+        absorbs duplicates, and a migrated copy is the same ticket).
+        Rebinding `M_SHARD` here is what makes the later ownership
+        transition (`_on_shard_moved`) pick these tickets up under
+        their new shard id. Tombstones for retired shard ids broadcast
+        to every owner — a cancelled ticket must not resurrect out of
+        a migrated slice — then drop."""
+        gone = set(old) - set(new)
+        dead = sorted(
+            tid for tid, sh in self._tombstones.items() if sh in gone
+        )
+        if dead:
+            for owner in self.directory.owners():
+                if owner and owner != self.node:
+                    try:
+                        self.bus.send(
+                            owner,
+                            "mm.remove",
+                            {"op": "tickets", "tickets": dead},
+                        )
+                    except Exception:
+                        pass
+            for tid in dead:
+                self._tombstones.pop(tid, None)
+        now = time.monotonic()
+        moved = sent = 0
+        for tid, m in self._meta.items():
+            p = m[M_PAYLOAD]
+            shard = self.directory.shard_for_key(
+                shard_key(p.get("q", "*"), p.get("sp") or {})
+            )
+            if shard == m[M_SHARD]:
+                continue
+            m[M_SHARD] = shard
+            moved += 1
+            owner = self.directory.owner_of(shard)[0]
+            if not owner or owner == self.node:
+                continue
+            m[M_AT] = now  # re-forwarded: the TTL clock resets
+            try:
+                if self.bus.send(owner, "mm.add", p):
+                    sent += 1
+            except Exception:
+                pass  # the reject/re-route or transition path covers it
+        if self.metrics is not None and sent:
+            self.metrics.cluster_forwards.labels(op="reforward").inc(
+                sent
+            )
+        if moved or dead:
+            self.logger.info(
+                "shard map changed: rebooked moved tickets",
+                generation=generation, moved=moved, sent=sent,
+                tombstones=len(dead), retired=sorted(gone),
+            )
+
 
 class ClusterMatchmakerIngest:
     """Owner-side bus endpoints feeding the REAL LocalMatchmaker.
@@ -580,6 +641,9 @@ class ClusterMatchmakerIngest:
         # be swept on a stale down-observation). Pruned lazily against
         # the live store.
         self._add_epoch: dict[str, int] = {}
+        # Handover fence (reshard): when set, keys mid-migration bounce
+        # back instead of landing in a pool slice that just parked.
+        self.is_frozen = None
         bus.on("mm.add", self._on_add)
         bus.on("mm.remove", self._on_remove)
 
@@ -626,6 +690,19 @@ class ClusterMatchmakerIngest:
             # updated directory instead of dropping the ticket.
             self.bus.send(
                 src, "mm.reject", {"ticket": tid, "reason": "not_owner"}
+            )
+            return
+        if self.is_frozen is not None and self.is_frozen(
+            shard_key(d.get("q", "*"), d.get("sp") or {})
+        ):
+            # Mid-handover keyspace: the slice just parked here and is
+            # being blessed to its new owner — an add landing now would
+            # be silently stranded. Bounce; the frontend holds and
+            # re-forwards on the ownership transition.
+            self.bus.send(
+                src,
+                "mm.reject",
+                {"ticket": tid, "reason": "not_owner:migrating"},
             )
             return
         try:

@@ -86,6 +86,13 @@ DEFAULT_RULES = {
     # A pull/fragment feed older than this marks the node STALE in
     # every federated view (and raises node_stale while it lasts).
     "stale_after_ms": 10_000.0,
+    # Reshard planner triggers (0 = the trigger is off; the planner
+    # itself only runs when cluster.reshard.enabled). Skew: hottest
+    # owner's ticket count over the owner mean; HBM: per-owner devobs
+    # ledger bytes; burn: merged-scenario 1h budget burn.
+    "reshard_skew_max": 0.0,
+    "reshard_hbm_max_bytes": 0.0,
+    "reshard_burn_1h_max": 0.0,
 }
 assert set(DEFAULT_RULES) == set(OBS_RULE_KEYS)
 
@@ -468,6 +475,11 @@ class HealthRuleEngine:
         self.ledger = Ledger(256)
         self.evaluations = 0
         self._published: set[tuple[str, str]] = set()
+        # Extra condition sources: callables yielding the same
+        # (rule, subject, severity, detail) tuples as `_desired` —
+        # subsystems with state the view doesn't carry (the reshard
+        # planner's active plan) get first-class raise→heal alerts.
+        self.extra_sources: list = []
 
     # -------------------------------------------------------- rule table
 
@@ -548,6 +560,13 @@ class HealthRuleEngine:
                     "scenario_burn", scenario, WARN,
                     f"merged 1h burn {b1h} >"
                     f" {th['scenario_burn_1h_max']}",
+                )
+        for source in self.extra_sources:
+            try:
+                yield from source()
+            except Exception as e:
+                self.logger.warn(
+                    "extra health-condition source error", error=str(e)
                 )
 
     # -------------------------------------------------------- evaluation
@@ -686,6 +705,10 @@ class FleetCollector:
         self.pulls_failed = 0
         self.rounds = 0
         self.status = OK
+        # ReshardPlanner (set by the plane when cluster.reshard is
+        # enabled): ticked once per pull round, AFTER evaluation — the
+        # planner's decisions read the same view the rules just judged.
+        self.planner = None
         self._task: asyncio.Task | None = None
 
     def start(self) -> None:
@@ -730,6 +753,14 @@ class FleetCollector:
         view = self.view()
         self.status = self.engine.evaluate(view)
         self._publish(view)
+        if self.planner is not None:
+            try:
+                await self.planner.tick(view)
+            except Exception as e:
+                # A planner round must never cost the collector loop.
+                self.logger.warn(
+                    "reshard planner tick error", error=str(e)
+                )
 
     async def _pull_one(self, peer: str) -> None:
         if not self.membership.is_up(peer):
@@ -877,10 +908,11 @@ class FleetCollector:
                 ),
                 "data": info["data"],
             }
-        return {
+        out = {
             "status": STATUS_NAMES[self.status],
             "nodes": nodes,
             "shards": view["shards"],
+            "generation": self.directory.generation,
             "slo_merged": view["slo_merged"],
             "alerts": self.engine.stats(),
             "pulls": {
@@ -891,6 +923,9 @@ class FleetCollector:
             },
             "traces": self.store.stats(),
         }
+        if self.planner is not None:
+            out["reshard"] = self.planner.stats()
+        return out
 
 
 # ------------------------------------------------------------------ plane
@@ -933,6 +968,7 @@ class FleetObsPlane:
         self.store: FleetTraceStore | None = None
         self.engine: HealthRuleEngine | None = None
         self.collector: FleetCollector | None = None
+        self.planner = None  # ReshardPlanner, collector-only
         if self.is_collector:
             self.store = FleetTraceStore(
                 capacity=cc.obs_trace_capacity
@@ -953,6 +989,28 @@ class FleetObsPlane:
                 pull_ms=self.pull_ms,
             )
             cluster.bus.on("obs.frag", self._on_frag)
+            if cc.reshard.enabled:
+                import os
+
+                from .reshard import ReshardPlanner
+
+                self.planner = ReshardPlanner(
+                    self.node,
+                    cluster.directory,
+                    rpc,
+                    self.logger,
+                    rules=self.engine.thresholds,
+                    journal_path=os.path.join(
+                        config.data_dir, "reshard_plan.json"
+                    ),
+                    local_migrator=cluster.migrator,
+                    plan_timeout_s=max(
+                        30.0, 4 * cc.reshard.handover_timeout_ms / 1000.0
+                    ),
+                )
+                # One raise→heal ledger entry per executed plan.
+                self.engine.extra_sources.append(self.planner.conditions)
+                self.collector.planner = self.planner
         self.exporter = TraceFragmentExporter(
             cluster.bus,
             self.node,

@@ -94,6 +94,7 @@ class ClusterPlane:
         self.shipper = None
         self.applier = None
         self.monitor: FailoverMonitor | None = None
+        self.migrator = None  # ShardMigrator (owners, reshard enabled)
         self._matchmaker = None
         self._ingest = None
         self._recovery = None
@@ -121,6 +122,14 @@ class ClusterPlane:
 
     def _hb_payload(self) -> dict:
         out: dict = {}
+        if self.directory.generation > 0:
+            # An edited (resharded) map rides every heartbeat: peers
+            # fold highest-generation-wins, so a node that missed the
+            # handover converges within one membership round.
+            out["map"] = {
+                "gen": self.directory.generation,
+                "shards": list(self.directory.shards),
+            }
         if self.lease is not None:
             out.update(self.lease.heartbeat_payload())
         promoted = self.monitor is not None and self.monitor.promoted
@@ -139,6 +148,17 @@ class ClusterPlane:
         return out
 
     def _fold_hb(self, src: str, body: dict) -> None:
+        # Map first: claims for split children must find their entries.
+        m = body.get("map")
+        if m:
+            try:
+                self.directory.apply_map(
+                    int(m["gen"]),
+                    [str(s) for s in m["shards"]],
+                    origin=src,
+                )
+            except (KeyError, TypeError, ValueError):
+                pass
         for c in body.get("claims", ()):
             try:
                 self.directory.claim(
@@ -245,6 +265,28 @@ class ClusterPlane:
                     self._lease_epochs_snapshot,
                     self._lease_epochs_restore,
                 )
+            if cc.reshard.enabled:
+                from .reshard import ShardMigrator
+
+                self.migrator = ShardMigrator(
+                    self.node,
+                    self.directory,
+                    self.lease,
+                    matchmaker,
+                    self.bus,
+                    self.membership,
+                    self.logger,
+                    journal=journal,
+                    metrics=self.metrics,
+                    drain_threshold_lsn=cc.reshard.drain_threshold_lsn,
+                    handover_timeout_s=(
+                        cc.reshard.handover_timeout_ms / 1000.0
+                    ),
+                )
+                if ingest is not None:
+                    # Handover fence: adds for a mid-migration keyspace
+                    # bounce (frontends hold + re-forward on transition).
+                    ingest.is_frozen = self.migrator.is_frozen
         elif self.is_standby:
             from .replication import JournalShipper, ReplicationApplier
 
@@ -298,10 +340,20 @@ class ClusterPlane:
         node's claims, which are fleet memory, not ours to persist)."""
         if self.lease is None:
             return {}
-        return {
+        epochs = {
             shard: self.directory.epoch_of(shard)
             for shard in sorted(self.lease.owned)
             if self.directory.epoch_of(shard) > 0
+        }
+        if self.directory.generation == 0:
+            return epochs  # static boot map: the legacy flat format
+        # An edited map must restart WITH its topology: a warm restart
+        # that rejoined the boot-config map would claim retired shard
+        # ids and strand the split children's keyspace.
+        return {
+            "generation": self.directory.generation,
+            "shards": list(self.directory.shards),
+            "epochs": epochs,
         }
 
     def _lease_epochs_restore(self, blob) -> None:
@@ -313,7 +365,20 @@ class ClusterPlane:
         highest-epoch-wins rule is untouched."""
         if not blob:
             return
-        for shard, epoch in blob.items():
+        epochs = blob
+        if isinstance(blob, dict) and "epochs" in blob:
+            # v2 (elastic) format: re-apply the durable map generation
+            # before folding epochs, so split-child entries exist. A
+            # legacy flat blob (pre-reshard checkpoint) skips this.
+            try:
+                gen = int(blob.get("generation") or 0)
+            except (TypeError, ValueError):
+                gen = 0
+            shards = [str(s) for s in blob.get("shards") or []]
+            if gen > 0 and shards:
+                self.directory.apply_map(gen, shards, origin="checkpoint")
+            epochs = blob.get("epochs") or {}
+        for shard, epoch in epochs.items():
             try:
                 epoch = int(epoch)
             except (TypeError, ValueError):
@@ -323,6 +388,12 @@ class ClusterPlane:
                 and epoch > self.directory.epoch_of(shard)
             ):
                 self.directory.claim(shard, self.node, epoch)
+                if self.lease is not None:
+                    # Post-reshard ownership (split children, moved
+                    # shards) isn't derivable from the node name — the
+                    # checkpoint is the authority. Live higher-epoch
+                    # claims folded during boot grace still demote us.
+                    self.lease.owned.add(shard)
 
     def _on_demoted(self, shard: str, new_owner: str, epoch: int):
         """A higher epoch replaced us (we were partitioned through a
@@ -423,6 +494,14 @@ class ClusterPlane:
             lease_ms=self.config.cluster.lease_ms,
             lease_grace_ms=self.config.cluster.lease_grace_ms,
         )
+        rs = self.config.cluster.reshard
+        if rs.enabled:
+            self.logger.info(
+                "elastic resharding enabled",
+                drain_threshold_lsn=rs.drain_threshold_lsn,
+                max_concurrent_migrations=rs.max_concurrent_migrations,
+                handover_timeout_ms=rs.handover_timeout_ms,
+            )
 
     async def stop(self):
         if self.monitor is not None:
@@ -438,7 +517,10 @@ class ClusterPlane:
             "membership": self.membership.stats(),
             "shards": self.directory.snapshot(),
             "epoch": self.directory.max_epoch(),
+            "generation": self.directory.generation,
         }
+        if self.migrator is not None:
+            out["reshard"] = self.migrator.stats()
         if self.lease is not None:
             out["lease"] = self.lease.stats()
         if self.shipper is not None:

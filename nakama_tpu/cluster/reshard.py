@@ -1,0 +1,920 @@
+"""Elastic shard topology: live ticket migration + the reshard planner.
+
+The protocol reuses the PR 11 failover recipe for a PLANNED topology
+change — snapshot, tail, lease handover — so a split/merge/move is
+"the standby-promotion path minus the death":
+
+1. **snapshot** — the source owner computes the moving slice (every
+   pool ticket whose key rendezvous-hashes to the moving shard under
+   the plan's post-edit map) and ships it to the target in chunked
+   ``reshard.snap`` frames on the ordered peer link.
+2. **tail** — the source keeps serving; it diff-ships adds/removes for
+   the slice (``reshard.tail``) until one round's delta is below
+   ``drain_threshold_lsn`` records.
+3. **handover** — the source PARKS the slice (removes it from its own
+   pool, payloads retained) and freezes ingest for the moving keyspace
+   (adds bounce ``not_owner``; frontends hold and re-forward on the
+   transition), then sends the blessing: ``reshard.handover`` carrying
+   the final delta, the post-edit map at ``generation+1`` and the
+   shard's current epoch. The target verifies its staging is complete
+   and gap-free, applies the map, inserts the slice, and claims the
+   shard at ``epoch+1`` — the standby-promotion claim, blessed instead
+   of grieving. The claim + map ride its next heartbeat; every node
+   folds highest-generation-wins / highest-epoch-wins.
+4. **confirm** — the source waits for that claim to fold back. On
+   success the parked slice is dropped (the target owns it); on
+   timeout the plan ABORTS: parked tickets re-insert, the source keeps
+   its lease, and the map generation never moved (only the target's
+   claim advances it) — a lost handover frame cannot split-brain the
+   map, and staged tickets never enter the target's live pool without
+   the blessing, so a mid-migration source death cannot double-deliver.
+
+The ``ReshardPlanner`` rides the fleet collector's pull cadence: it
+evaluates declarative triggers (pool-size skew, per-owner HBM ledger,
+SLO burn rate — thresholds under the ``OBS_RULE_KEYS`` contract),
+executes one migration at a time, and journals every plan transition
+so a collector restart never replays a half-applied plan."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from collections import deque
+from typing import Callable
+
+from .. import faults
+from ..logger import Logger
+from .ops import ClusterOpError
+from .replication import SNAPSHOT_CHUNK, extract_to_payload
+from .sharding import (
+    ShardDirectory,
+    parent_shard,
+    rendezvous_shard,
+    shard_key,
+)
+
+# reshard_state{phase} gauge encoding: one-hot over these.
+PHASES = ("idle", "snapshot", "tail", "handover", "confirm")
+
+# Target-side staging entries older than this are abandoned (a source
+# that died mid-migration never sends handover OR abort).
+STAGING_TTL_S = 120.0
+
+
+class _Abort(Exception):
+    """Internal: a phase failed; roll the plan back."""
+
+
+def plan_check(plan: dict, directory: ShardDirectory, node: str) -> str:
+    """Validate a split/merge/move plan against the current map as seen
+    by the SOURCE node. Returns "" when executable, else the refusal
+    (pure — unit-testable without a rig)."""
+    for k in ("plan_id", "kind", "shard", "shards", "source", "target"):
+        if not plan.get(k):
+            return f"plan missing {k!r}"
+    kind = plan["kind"]
+    if kind not in ("split", "merge", "move"):
+        return f"unknown plan kind {kind!r}"
+    if plan["source"] != node:
+        return "plan source is not this node"
+    shards = list(dict.fromkeys(plan["shards"]))
+    if len(shards) != len(plan["shards"]):
+        return "plan shard list has duplicates"
+    if plan["shard"] not in shards:
+        return "moving shard is not in the plan map"
+    cur = set(directory.shards)
+    if kind == "move":
+        if plan["target"] == plan["source"]:
+            return "move target == source"
+        if set(shards) != cur:
+            return "a move must not edit the shard map"
+        if directory.owner_of(plan["shard"])[0] != node:
+            return "source does not own the moving shard"
+    elif kind == "split":
+        p = parent_shard(plan["shard"])
+        if p == plan["shard"] or p not in cur:
+            return (
+                "split child must be parent/N of a current shard"
+                " (one level of splitting)"
+            )
+        if directory.owner_of(p)[0] != node:
+            return "source does not own the split parent"
+        kids = {s for s in shards if s != p and parent_shard(s) == p}
+        if len(kids) < 2:
+            return "a split needs >= 2 children"
+        if set(shards) != (cur - {p}) | kids:
+            return "split map edit malformed"
+        if plan["target"] == plan["source"]:
+            return "split target == source (nothing would move)"
+    else:  # merge
+        p = plan["shard"]
+        if "/" in p:
+            return "merge target must be a parent shard id"
+        kids = {s for s in cur if s != p and parent_shard(s) == p}
+        if not kids:
+            return "no children of the merge target in the map"
+        for k in sorted(kids):
+            if directory.owner_of(k)[0] != node:
+                return "source must own every merged child"
+        if set(shards) != (cur - kids) | {p}:
+            return "merge map edit malformed"
+    return ""
+
+
+class ShardMigrator:
+    """Owner-side live-migration state machine — SOURCE for slices this
+    node hands off, TARGET for slices it receives. One migration at a
+    time per node; rollback posture throughout (see module docstring).
+    """
+
+    TAIL_ROUNDS_MAX = 200   # hard bound on the drain loop
+    TAIL_INTERVAL_S = 0.05
+
+    def __init__(
+        self,
+        node: str,
+        directory: ShardDirectory,
+        lease,
+        matchmaker,
+        bus,
+        membership,
+        logger: Logger,
+        *,
+        journal=None,
+        metrics=None,
+        drain_threshold_lsn: int = 16,
+        handover_timeout_s: float = 8.0,
+        clock=time.monotonic,
+    ):
+        self.node = node
+        self.directory = directory
+        self.lease = lease
+        self.mm = matchmaker
+        self.bus = bus
+        self.membership = membership
+        self.logger = logger.with_fields(subsystem="cluster.reshard")
+        self.journal = journal
+        self.metrics = metrics
+        self.drain_threshold = max(1, int(drain_threshold_lsn))
+        self.handover_timeout_s = max(0.05, float(handover_timeout_s))
+        self._clock = clock
+        self.phase = "idle"
+        self.plan: dict | None = None
+        self._task: asyncio.Task | None = None
+        # Handover fence: (moving shard id, plan map) — ingest bounces
+        # adds whose key rendezvous-hashes into the moving slice.
+        self._frozen: tuple[str, list[str]] | None = None
+        # Target side: plan_id -> staging (never live until handover).
+        self._staging: dict[str, dict] = {}
+        self._done: deque[str] = deque(maxlen=64)
+        # Ledger totals (console/tests/bench).
+        self.migrated_out = 0
+        self.migrated_in = 0
+        self.completed = 0
+        self.aborts = 0
+        self.refused_handovers = 0
+        bus.on("reshard.snap", self._on_snap)
+        bus.on("reshard.tail", self._on_tail)
+        bus.on("reshard.handover", self._on_handover)
+        bus.on("reshard.abort", self._on_abort)
+        self._set_phase("idle")
+
+    # ----------------------------------------------------------- common
+
+    def _set_phase(self, phase: str) -> None:
+        self.phase = phase
+        if self.metrics is not None:
+            try:
+                for p in PHASES:
+                    self.metrics.reshard_state.labels(phase=p).set(
+                        1 if p == phase else 0
+                    )
+            except Exception:
+                pass
+
+    def is_frozen(self, key: str) -> bool:
+        """Ingest fence: is this routing key mid-handover? (Bounced
+        adds hold at the frontend and re-forward on the transition.)"""
+        f = self._frozen
+        if f is None:
+            return False
+        shard, shards = f
+        return rendezvous_shard(key, shards) == shard
+
+    @staticmethod
+    def _key(ex) -> str:
+        return shard_key(ex.query, ex.string_properties)
+
+    def _moving(self, plan: dict) -> dict:
+        """ticket id -> extract for the slice that moves under `plan`:
+        everything whose key lands on the moving shard in the POST-edit
+        map (for a move that IS the shard's whole slice; for a split,
+        the child's share of the parent keyspace; for a merge, every
+        child's tickets)."""
+        shards = plan["shards"]
+        shard = plan["shard"]
+        return {
+            ex.ticket: ex
+            for ex in self.mm.extract()
+            if rendezvous_shard(self._key(ex), shards) == shard
+        }
+
+    def _lsn(self) -> int:
+        return self.journal.lsn if self.journal is not None else 0
+
+    # ----------------------------------------------------- source side
+
+    def on_begin(self, src: str, body: dict) -> dict:
+        """``reshard.begin`` RPC handler: validate and launch the
+        migration task. Refusals travel back typed — the planner
+        journals them as aborted, never half-applied."""
+        plan = dict(body.get("plan") or {})
+        if self.phase != "idle":
+            raise ClusterOpError(
+                f"migration already active ({self.phase})", "busy"
+            )
+        err = plan_check(plan, self.directory, self.node)
+        if err:
+            raise ClusterOpError(f"plan refused: {err}", "invalid")
+        self.plan = plan
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(plan)
+        )
+        self.logger.info(
+            "reshard plan accepted",
+            plan_id=plan["plan_id"], kind=plan["kind"],
+            shard=plan["shard"], target=plan["target"],
+        )
+        return {"accepted": plan["plan_id"]}
+
+    def _ship(self, target: str, kind: str, body: dict) -> None:
+        """One migration frame. An armed drop-mode ``reshard.migrate``
+        loses the frame IN FLIGHT (the source doesn't know) — the
+        target's seq tracking detects the gap and refuses the handover,
+        so the plan aborts instead of losing tickets. Raise mode (and a
+        refused send) abort immediately."""
+        if faults.fire("reshard.migrate"):
+            return  # dropped in flight; the seq gap is the detector
+        if not self.bus.send(target, kind, body):
+            raise _Abort(f"bus refused {kind} to {target}")
+
+    async def _run(self, plan: dict) -> None:
+        target = plan["target"]
+        pid = plan["plan_id"]
+        gen = self.directory.generation + 1
+        local = target == self.node
+        parked: dict = {}
+        try:
+            if local:
+                # A merge back onto this node moves nothing: pure map
+                # edit + self-claim at epoch+1, broadcast by heartbeat.
+                epoch = self._handover_epoch(plan)
+                self.directory.apply_map(gen, plan["shards"], origin=pid)
+                self.lease.adopt(plan["shard"], epoch + 1)
+                self._adopt_retained(plan)
+                if self.membership is not None:
+                    self.membership.beat_now()
+                self.completed += 1
+                self.logger.info(
+                    "reshard local map edit applied",
+                    plan_id=pid, generation=gen, shard=plan["shard"],
+                )
+                return
+            # Phase 1: snapshot the moving slice.
+            self._set_phase("snapshot")
+            moving = self._moving(plan)
+            payloads = [extract_to_payload(ex) for ex in moving.values()]
+            chunks = [
+                payloads[i : i + SNAPSHOT_CHUNK]
+                for i in range(0, len(payloads), SNAPSHOT_CHUNK)
+            ] or [[]]
+            n = len(chunks)
+            for i, chunk in enumerate(chunks):
+                self._ship(target, "reshard.snap", {
+                    "plan_id": pid, "shard": plan["shard"],
+                    "seq": i, "n": n, "lsn": self._lsn(),
+                    "tickets": chunk, "t": time.time(),
+                })
+            shipped = set(moving)
+            self.logger.info(
+                "reshard snapshot shipped",
+                plan_id=pid, tickets=len(shipped), chunks=n,
+                target=target,
+            )
+            # Phase 2: diff-ship the tail until one round's delta is
+            # below the drain threshold.
+            self._set_phase("tail")
+            tail_seq = 0
+            for _ in range(self.TAIL_ROUNDS_MAX):
+                await asyncio.sleep(self.TAIL_INTERVAL_S)
+                cur = self._moving(plan)
+                fresh = [
+                    extract_to_payload(ex)
+                    for t, ex in cur.items()
+                    if t not in shipped
+                ]
+                removed = sorted(shipped - set(cur))
+                if fresh or removed:
+                    tail_seq += 1
+                    self._ship(target, "reshard.tail", {
+                        "plan_id": pid, "seq": tail_seq,
+                        "records": fresh, "removed": removed,
+                        "lsn": self._lsn(),
+                    })
+                    shipped |= {p["ticket"] for p in fresh}
+                    shipped -= set(removed)
+                if len(fresh) + len(removed) < self.drain_threshold:
+                    break
+            # Phase 3: park the slice, freeze its keyspace, send the
+            # blessing with the final delta.
+            self._set_phase("handover")
+            self._frozen = (plan["shard"], list(plan["shards"]))
+            parked = self._moving(plan)
+            if parked:
+                self.mm.remove(list(parked))
+            final = [
+                extract_to_payload(ex)
+                for t, ex in parked.items()
+                if t not in shipped
+            ]
+            removed = sorted(shipped - set(parked))
+            epoch = self._handover_epoch(plan)
+            frame = {
+                "plan_id": pid, "kind": plan["kind"],
+                "shard": plan["shard"], "gen": gen,
+                "shards": list(plan["shards"]), "epoch": epoch,
+                "final": final, "removed": removed,
+                "total": len(parked), "t": time.time(),
+            }
+            try:
+                if faults.fire("reshard.handover"):
+                    self.logger.warn(
+                        "reshard handover frame dropped (fault)",
+                        plan_id=pid,
+                    )
+                else:
+                    self.bus.send(target, "reshard.handover", frame)
+            except Exception as e:
+                raise _Abort(f"handover send failed: {e}") from e
+            # Phase 4: wait for the target's epoch+1 claim (and, for a
+            # map edit, the new generation) to fold back via heartbeat.
+            self._set_phase("confirm")
+            deadline = self._clock() + self.handover_timeout_s
+            confirmed = False
+            while self._clock() < deadline:
+                owner, ep = self.directory.owner_of(plan["shard"])
+                if owner == target and ep > epoch and (
+                    plan["kind"] == "move"
+                    or self.directory.generation >= gen
+                ):
+                    confirmed = True
+                    break
+                await asyncio.sleep(0.05)
+            if not confirmed:
+                raise _Abort(
+                    "handover not confirmed before deadline"
+                    " (dropped blessing or dead target)"
+                )
+            # Success: the target owns the slice; drop the parked copy.
+            self._adopt_retained(plan)
+            self.migrated_out += len(parked)
+            self.completed += 1
+            if self.metrics is not None:
+                try:
+                    self.metrics.reshard_migrated_tickets.inc(
+                        len(parked)
+                    )
+                except Exception:
+                    pass
+            self.logger.info(
+                "reshard migration complete",
+                plan_id=pid, shard=plan["shard"], target=target,
+                tickets=len(parked), generation=self.directory.generation,
+            )
+        except Exception as e:
+            # Rollback: the source keeps its lease, the parked slice
+            # re-inserts (zero loss), the target discards its staging.
+            self.aborts += 1
+            if parked:
+                try:
+                    self.mm.insert(list(parked.values()))
+                except Exception as ie:
+                    self.logger.error(
+                        "reshard abort re-insert failed",
+                        plan_id=pid, error=str(ie),
+                    )
+            try:
+                self.bus.send(target, "reshard.abort", {"plan_id": pid})
+            except Exception:
+                pass
+            log = (
+                self.logger.warn
+                if isinstance(e, _Abort)
+                else self.logger.error
+            )
+            log(
+                "reshard migration aborted — source keeps the lease",
+                plan_id=pid, reason=str(e), parked=len(parked),
+            )
+        finally:
+            self._frozen = None
+            self.plan = None
+            self._set_phase("idle")
+
+    def _handover_epoch(self, plan: dict) -> int:
+        """The epoch the target's claim must exceed: the moving shard's
+        own entry for a move; the parent's for a split child (the
+        child entry does not exist at the source until the map edit
+        folds back); the children's max for a merge."""
+        kind = plan["kind"]
+        if kind == "move":
+            return self.directory.epoch_of(plan["shard"])
+        if kind == "split":
+            return self.directory.epoch_of(parent_shard(plan["shard"]))
+        return max(
+            (
+                self.directory.epoch_of(s)
+                for s in self.directory.shards
+                if parent_shard(s) == plan["shard"]
+            ),
+            default=0,
+        )
+
+    def _adopt_retained(self, plan: dict) -> None:
+        """After a split's map edit folds back, this node still owns
+        the children it did NOT hand off (they inherited its entry).
+        Put them in the lease's owned set so renewals continue; the
+        retired parent drops out on the next heartbeat."""
+        if self.lease is None:
+            return
+        for s in self.directory.shards:
+            if (
+                s != plan["shard"]
+                and self.directory.owner_of(s)[0] == self.node
+                and s not in self.lease.owned
+                and parent_shard(s) in (
+                    parent_shard(plan["shard"]), plan["shard"]
+                )
+            ):
+                self.lease.adopt(s, self.directory.epoch_of(s))
+
+    # ----------------------------------------------------- target side
+
+    def _gc_staging(self) -> None:
+        now = time.time()
+        for pid in [
+            p for p, st in self._staging.items()
+            if now - st["at"] > STAGING_TTL_S
+        ]:
+            self._staging.pop(pid, None)
+            self.logger.warn(
+                "reshard staging abandoned (source silent)", plan_id=pid
+            )
+
+    def _on_snap(self, src: str, d: dict) -> None:
+        self._gc_staging()
+        pid = str(d.get("plan_id", ""))
+        if not pid or pid in self._done:
+            return
+        seq, n = int(d.get("seq", 0)), int(d.get("n", 1))
+        st = self._staging.get(pid)
+        if seq == 0 or st is None:
+            st = self._staging[pid] = {
+                "shard": str(d.get("shard", "")), "source": src,
+                "n": n, "next_seq": 0, "tail_seq": 0,
+                "tickets": {}, "broken": False, "at": time.time(),
+            }
+        if st["broken"]:
+            return
+        if seq != st["next_seq"] or n != st["n"]:
+            st["broken"] = True  # a dropped/reordered chunk: refuse later
+            return
+        st["next_seq"] = seq + 1
+        st["at"] = time.time()
+        for p in d.get("tickets") or []:
+            tid = p.get("ticket")
+            if tid:
+                st["tickets"][tid] = p
+
+    def _on_tail(self, src: str, d: dict) -> None:
+        pid = str(d.get("plan_id", ""))
+        st = self._staging.get(pid)
+        if st is None or st["broken"]:
+            return
+        seq = int(d.get("seq", 0))
+        if seq != st["tail_seq"] + 1:
+            st["broken"] = True  # a dropped tail frame loses adds: refuse
+            return
+        st["tail_seq"] = seq
+        st["at"] = time.time()
+        for p in d.get("records") or []:
+            tid = p.get("ticket")
+            if tid:
+                st["tickets"][tid] = p
+        for tid in d.get("removed") or []:
+            st["tickets"].pop(tid, None)
+
+    def _on_handover(self, src: str, d: dict) -> None:
+        """The blessing: verify staging is complete, apply the map
+        edit, insert the slice, claim at epoch+1 and beat immediately.
+        Staged tickets reach the live pool ONLY here — a plan whose
+        blessing never arrives leaves them inert until the TTL sweeps
+        the staging away."""
+        pid = str(d.get("plan_id", ""))
+        if not pid or pid in self._done:
+            return
+        st = self._staging.pop(pid, None)
+        complete = (
+            st is not None
+            and not st["broken"]
+            and st["next_seq"] == st["n"]
+        )
+        if not complete:
+            self.refused_handovers += 1
+            self.logger.warn(
+                "refused reshard handover: staging incomplete"
+                " (dropped migration frame?) — source will abort",
+                plan_id=pid,
+                broken=bool(st and st["broken"]),
+            )
+            return
+        tickets = st["tickets"]
+        for p in d.get("final") or []:
+            tid = p.get("ticket")
+            if tid:
+                tickets[tid] = p
+        for tid in d.get("removed") or []:
+            tickets.pop(tid, None)
+        kind = str(d.get("kind", ""))
+        gen = int(d.get("gen", 0))
+        shard = str(d.get("shard", ""))
+        if kind != "move":
+            if not self.directory.apply_map(
+                gen, list(d.get("shards") or []), origin=src
+            ) and self.directory.generation < gen:
+                self.logger.warn(
+                    "reshard handover map edit refused", plan_id=pid
+                )
+                return
+        from ..recovery import payload_to_extract
+
+        extracts = []
+        for p in tickets.values():
+            try:
+                extracts.append(payload_to_extract(p))
+            except Exception as e:
+                self.logger.warn(
+                    "reshard payload dropped", error=str(e)
+                )
+        live = [t for t in tickets if t in self.mm.store]
+        if live:
+            try:
+                self.mm.remove(live)
+            except Exception:
+                pass
+        if extracts:
+            self.mm.insert(extracts)
+        epoch = int(d.get("epoch", 0)) + 1
+        if self.lease is not None:
+            self.lease.adopt(shard, epoch)
+        else:
+            self.directory.claim(shard, self.node, epoch)
+        if self.membership is not None:
+            self.membership.beat_now()
+        self._done.append(pid)
+        self.migrated_in += len(extracts)
+        self.logger.info(
+            "reshard handover applied: this node now owns the shard",
+            plan_id=pid, shard=shard, epoch=epoch,
+            tickets=len(extracts),
+            generation=self.directory.generation,
+        )
+
+    def _on_abort(self, src: str, d: dict) -> None:
+        pid = str(d.get("plan_id", ""))
+        if self._staging.pop(pid, None) is not None:
+            self.logger.info(
+                "reshard staging discarded (source aborted)",
+                plan_id=pid,
+            )
+
+    def stats(self) -> dict:
+        out = {
+            "phase": self.phase,
+            "migrated_out": self.migrated_out,
+            "migrated_in": self.migrated_in,
+            "completed": self.completed,
+            "aborts": self.aborts,
+            "refused_handovers": self.refused_handovers,
+            "staging": len(self._staging),
+        }
+        if self.plan is not None:
+            out["plan"] = {
+                k: self.plan.get(k)
+                for k in ("plan_id", "kind", "shard", "target")
+            }
+        return out
+
+
+class PlanJournal:
+    """One-plan journal on the collector: every transition (started →
+    done | aborted) is an atomic file replace. On load, a plan still
+    ``started`` is marked aborted — a collector restart must never
+    replay a half-applied plan (the source's own rollback already
+    cleaned up or completed; re-driving it blind could double-move)."""
+
+    def __init__(self, path: str, logger: Logger):
+        self.path = path
+        self.logger = logger
+        self.recovered_abort: dict | None = None
+        if not path:
+            return
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            return
+        if isinstance(rec, dict) and rec.get("state") == "started":
+            rec["state"] = "aborted"
+            rec["note"] = "collector restarted mid-plan; not replayed"
+            self.write(rec)
+            self.recovered_abort = rec
+            self.logger.warn(
+                "half-applied reshard plan found at boot — journaled"
+                " aborted, never replayed",
+                plan_id=(rec.get("plan") or {}).get("plan_id"),
+            )
+
+    def write(self, rec: dict) -> None:
+        if not self.path:
+            return
+        try:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(rec, fh)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            self.logger.warn(
+                "reshard plan journal write failed", error=str(e)
+            )
+
+
+class ReshardPlanner:
+    """Collector-side decision loop, driven once per obs pull round.
+
+    Declarative triggers (all default-off; thresholds ride
+    ``cluster.obs_rules``): ``reshard_skew_max`` — hottest owner's
+    ticket count vs the owner mean; ``reshard_hbm_max_bytes`` — the
+    per-owner devobs HBM ledger; ``reshard_burn_1h_max`` — merged SLO
+    burn rate. Any trigger (or an operator-submitted plan) yields ONE
+    split of the hot owner's shard toward a reserve owner — one
+    migration at a time, journaled, surfaced as a raise→heal
+    ``reshard_active`` alert through the health-rule engine."""
+
+    # Below this many tickets on the hot owner skew is noise, not load.
+    SKEW_MIN_TICKETS = 16
+
+    def __init__(
+        self,
+        node: str,
+        directory: ShardDirectory,
+        rpc,
+        logger: Logger,
+        *,
+        rules: dict | None = None,
+        journal_path: str = "",
+        local_migrator: ShardMigrator | None = None,
+        plan_timeout_s: float = 60.0,
+        clock=time.monotonic,
+    ):
+        self.node = node
+        self.directory = directory
+        self.rpc = rpc
+        self.logger = logger.with_fields(subsystem="cluster.reshard")
+        self.rules = dict(rules or {})
+        self.local_migrator = local_migrator
+        self.plan_timeout_s = plan_timeout_s
+        self._clock = clock
+        self.journal = PlanJournal(journal_path, self.logger)
+        self.active: dict | None = None
+        self.history: deque[dict] = deque(maxlen=32)
+        self._pending: deque[dict] = deque()
+        self.dispatched = 0
+        self.completed = 0
+        self.aborted = 0
+        if self.journal.recovered_abort is not None:
+            self.history.append(self.journal.recovered_abort)
+            self.aborted += 1
+
+    # ------------------------------------------------------ health hook
+
+    def conditions(self):
+        """Extra health-rule conditions: exactly one ``reshard_active``
+        alert per executing plan (severity WARN=1) — it heals when the
+        plan leaves the active slot, giving the ledger its raise→heal
+        pair."""
+        if self.active is not None:
+            plan = self.active["plan"]
+            yield (
+                "reshard_active",
+                plan["plan_id"],
+                1,  # WARN (obs.py severity encoding)
+                f"{plan['kind']} {plan['shard']} -> {plan['target']}",
+            )
+
+    # -------------------------------------------------------- operator
+
+    def submit(self, plan: dict) -> dict:
+        """Operator-submitted plan (console POST). Validated fully at
+        the source; minimal shape gate here."""
+        for k in ("kind", "shard", "shards", "source", "target"):
+            if not plan.get(k):
+                raise ValueError(f"plan missing {k!r}")
+        plan.setdefault(
+            "plan_id",
+            f"g{self.directory.generation + 1}-{plan['kind']}-"
+            f"{str(plan['shard']).replace('/', '_')}",
+        )
+        self._pending.append(plan)
+        return {"queued": plan["plan_id"], "pending": len(self._pending)}
+
+    # ------------------------------------------------------------ loop
+
+    async def tick(self, view: dict) -> None:
+        """One planner round on the collector pull cadence. Drop-mode
+        ``reshard.plan`` skips the round; raise mode costs the round,
+        never the collector loop (the caller guards)."""
+        if faults.fire("reshard.plan"):
+            return
+        if self.active is not None:
+            self._check_active()
+            return
+        plan = (
+            self._pending.popleft()
+            if self._pending
+            else self._auto_plan(view)
+        )
+        if plan is None:
+            return
+        rec = {"plan": plan, "state": "started", "t": time.time()}
+        self.journal.write(rec)
+        self.active = {"plan": plan, "at": self._clock()}
+        try:
+            if (
+                plan["source"] == self.node
+                and self.local_migrator is not None
+            ):
+                self.local_migrator.on_begin(self.node, {"plan": plan})
+            else:
+                await self.rpc.call(
+                    plan["source"], "reshard.begin", {"plan": plan}
+                )
+            self.dispatched += 1
+            self.logger.info(
+                "reshard plan dispatched",
+                plan_id=plan["plan_id"], kind=plan["kind"],
+                shard=plan["shard"], source=plan["source"],
+                target=plan["target"], reason=plan.get("reason", ""),
+            )
+        except Exception as e:
+            self._finish("aborted", f"dispatch failed: {e}")
+
+    def _check_active(self) -> None:
+        plan = self.active["plan"]
+        owner, _ = self.directory.owner_of(plan["shard"])
+        if owner == plan["target"]:
+            self._finish("done")
+            return
+        if self._clock() - self.active["at"] > self.plan_timeout_s:
+            self._finish("aborted", "plan deadline exceeded")
+
+    def _finish(self, state: str, note: str = "") -> None:
+        plan = self.active["plan"]
+        rec = {"plan": plan, "state": state, "t": time.time()}
+        if note:
+            rec["note"] = note
+        self.journal.write(rec)
+        self.history.append(rec)
+        self.active = None
+        if state == "done":
+            self.completed += 1
+            self.logger.info(
+                "reshard plan complete",
+                plan_id=plan["plan_id"],
+                generation=self.directory.generation,
+            )
+        else:
+            self.aborted += 1
+            self.logger.warn(
+                "reshard plan aborted",
+                plan_id=plan["plan_id"], note=note,
+            )
+
+    # ----------------------------------------------------------- rules
+
+    def _auto_plan(self, view: dict) -> dict | None:
+        """Evaluate the declarative triggers against the collector's
+        merged view; return one split plan or None. Pure over (view,
+        directory, rules) — unit-testable with a fake view."""
+        nodes = view.get("nodes") or {}
+        owners = {
+            s: self.directory.owner_of(s)[0]
+            for s in self.directory.shards
+        }
+        owner_nodes = {n for n in owners.values() if n}
+        counts: dict[str, int] = {}
+        hbm: dict[str, int] = {}
+        reserves: list[str] = []
+        for name, info in nodes.items():
+            data = info.get("data") or {}
+            if info.get("stale"):
+                continue
+            counts[name] = int(data.get("matchmaker_tickets") or 0)
+            dv = data.get("devobs") or {}
+            hbm[name] = int(dv.get("memory_total_bytes") or 0)
+            role = (data.get("cluster") or {}).get("role", "")
+            if role == "device_owner" and name not in owner_nodes:
+                reserves.append(name)
+        if not reserves:
+            return None  # nowhere to grow
+        trigger = None
+        skew_max = float(self.rules.get("reshard_skew_max") or 0.0)
+        owner_counts = {
+            n: counts.get(n, 0) for n in sorted(owner_nodes)
+        }
+        if skew_max > 0 and owner_counts:
+            mean = sum(owner_counts.values()) / len(owner_counts)
+            hot = max(owner_counts, key=owner_counts.get)
+            if (
+                mean > 0
+                and owner_counts[hot] >= self.SKEW_MIN_TICKETS
+                and owner_counts[hot] / mean >= skew_max
+            ):
+                trigger = (
+                    hot,
+                    f"skew: {owner_counts[hot]} tickets vs"
+                    f" {mean:.1f} mean",
+                )
+        hbm_max = float(self.rules.get("reshard_hbm_max_bytes") or 0.0)
+        if trigger is None and hbm_max > 0:
+            for n in sorted(owner_nodes):
+                if hbm.get(n, 0) > hbm_max:
+                    trigger = (n, f"hbm: {hbm[n]} bytes > {hbm_max:g}")
+                    break
+        burn_max = float(self.rules.get("reshard_burn_1h_max") or 0.0)
+        if trigger is None and burn_max > 0:
+            for name, row in sorted(
+                (view.get("slo_merged") or {}).items()
+            ):
+                if float(row.get("burn_1h") or 0.0) >= burn_max:
+                    hot = max(
+                        owner_counts, key=owner_counts.get
+                    ) if owner_counts else None
+                    if hot:
+                        trigger = (
+                            hot,
+                            f"burn: {name} 1h burn"
+                            f" {row.get('burn_1h')} >= {burn_max:g}",
+                        )
+                    break
+        if trigger is None:
+            return None
+        hot, reason = trigger
+        splittable = [
+            s for s in self.directory.shards_owned_by(hot)
+            if "/" not in s
+        ]
+        if not splittable:
+            return None  # already split; one level of elasticity
+        p = splittable[0]
+        shards = [s for s in self.directory.shards if s != p]
+        shards += [f"{p}/0", f"{p}/1"]
+        return {
+            "plan_id": (
+                f"g{self.directory.generation + 1}-split-{p}"
+            ),
+            "kind": "split",
+            "shard": f"{p}/1",
+            "shards": shards,
+            "source": hot,
+            "target": reserves[0],
+            "reason": reason,
+        }
+
+    def stats(self) -> dict:
+        out = {
+            "dispatched": self.dispatched,
+            "completed": self.completed,
+            "aborted": self.aborted,
+            "pending": len(self._pending),
+            "history": list(self.history),
+        }
+        if self.active is not None:
+            plan = self.active["plan"]
+            out["active"] = {
+                "plan_id": plan["plan_id"], "kind": plan["kind"],
+                "shard": plan["shard"], "target": plan["target"],
+            }
+        return out

@@ -4,16 +4,24 @@ claims into.
 
 Two layers, deliberately separated:
 
-- The **keyspace** is static: shard ids are the configured owner-fleet
-  node names (``cluster.shards``; a single-owner deployment is the
-  degenerate one-shard fleet). A ticket's key — its pool property when
-  set, else its query family — rendezvous-hashes over the shard ids,
-  so the key→shard assignment never moves while the fleet config is
-  stable. Pools are the unit of sharding because pools are the unit of
-  matching: tickets in different pools never form a cohort (the
-  ``cfg5_8x20k_multipool`` bench is exactly this batching), so a shard
-  is a self-contained matchmaking domain with its own device pool and
-  interval loop.
+- The **keyspace** is generation-versioned: shard ids start as the
+  configured owner-fleet node names (``cluster.shards``; a
+  single-owner deployment is the degenerate one-shard fleet) and may
+  be edited at runtime by a reshard plan (reshard.py). A ticket's
+  key — its pool property when set, else its query family —
+  rendezvous-hashes over the shard ids, so the key→shard assignment
+  only moves on an explicit map edit. A split names its children
+  ``parent/N``: children rendezvous over the *parent's* keyspace
+  (parent-first, then child rendezvous), so splitting one shard never
+  moves another shard's keys — a split is a pure map edit. The map
+  carries a monotonically increasing *generation*; every node folds
+  maps with a strict highest-generation-wins rule (an equal-generation
+  conflicting map is refused — no duels), broadcast on the same
+  heartbeat path as lease claims. Pools are the unit of sharding
+  because pools are the unit of matching: tickets in different pools
+  never form a cohort (the ``cfg5_8x20k_multipool`` bench is exactly
+  this batching), so a shard is a self-contained matchmaking domain
+  with its own device pool and interval loop.
 
 - The **ownership** of each shard is dynamic and epoch-versioned: an
   owner renews its claim on every heartbeat (lease.py), and a
@@ -48,20 +56,43 @@ def shard_key(query: str, string_properties=None) -> str:
     return sp.get("pool") or query or "*"
 
 
-def rendezvous_shard(key: str, shards: list[str]) -> str:
-    """Highest-random-weight (rendezvous) hash of `key` over the static
-    shard-id list: every node computes the same winner with no shared
-    state, and removing one shard id only moves that shard's keys."""
-    if not shards:
-        raise ValueError("no shards configured")
-    if len(shards) == 1:
-        return shards[0]
-    best, best_w = shards[0], b""
-    for s in shards:
+def parent_shard(shard: str) -> str:
+    """A split child ``parent/N`` routes inside ``parent``'s keyspace;
+    a flat shard id is its own parent."""
+    return shard.split("/", 1)[0]
+
+
+def _hrw(key: str, ids: list[str]) -> str:
+    best, best_w = ids[0], b""
+    for s in ids:
         w = hashlib.md5(f"{s}\x00{key}".encode()).digest()
         if w > best_w:
             best, best_w = s, w
     return best
+
+
+def rendezvous_shard(key: str, shards: list[str]) -> str:
+    """Highest-random-weight (rendezvous) hash of `key` over the shard
+    ids: every node computes the same winner with no shared state, and
+    removing one shard id only moves that shard's keys.
+
+    Split form: children named ``parent/N`` rendezvous over the
+    parent's keyspace — the key first picks a parent among the
+    distinct parent ids, then (if that parent is split) picks one of
+    its children. Keys of unsplit shards never move when another
+    shard splits, and a flat list behaves exactly as before."""
+    if not shards:
+        raise ValueError("no shards configured")
+    if len(shards) == 1:
+        return shards[0]
+    groups: dict[str, list[str]] = {}
+    for s in shards:
+        groups.setdefault(parent_shard(s), []).append(s)
+    if len(groups) == 1:
+        members = next(iter(groups.values()))
+        return members[0] if len(members) == 1 else _hrw(key, members)
+    members = groups[_hrw(key, sorted(groups))]
+    return members[0] if len(members) == 1 else _hrw(key, members)
 
 
 class ShardDirectory:
@@ -101,6 +132,12 @@ class ShardDirectory:
         # (shard, old_node, new_node, epoch) per ownership CHANGE.
         self.on_transition: list[Callable[[str, str, str, int], None]] = []
         self.takeovers = 0  # ledger total (console/tests)
+        # Map generation: 0 = the boot-time config map. Bumped only by
+        # apply_map; (generation, old_shards, new_shards) per map edit.
+        self.generation = 0
+        self.on_map_change: list[
+            Callable[[int, list[str], list[str]], None]
+        ] = []
         self._publish_gauges()
 
     # ----------------------------------------------------------- routing
@@ -137,6 +174,76 @@ class ShardDirectory:
         return sorted(
             s for s, e in self._entries.items() if e[0] == node
         )
+
+    # --------------------------------------------------------- map edits
+
+    def apply_map(
+        self, generation: int, shards: list[str], origin: str = ""
+    ) -> bool:
+        """Fold one shard-map broadcast. Strict highest-generation-wins:
+        an older or equal generation is refused (an equal-generation
+        *conflicting* map is the reshard analogue of an equal-epoch
+        duel and logs loudly). New shards inherit their lease entry:
+        a split child copies its parent's owner+epoch (the source owner
+        keeps serving until the handover claim at epoch+1), a merged
+        parent inherits its highest-epoch child, and a brand-new shard
+        seeds self-owned at epoch 0 exactly like boot."""
+        new = list(dict.fromkeys(shards))
+        if not new:
+            return False
+        if generation <= self.generation:
+            if (
+                generation == self.generation
+                and generation > 0
+                and set(new) != set(self.shards)
+                and self.logger is not None
+            ):
+                self.logger.warn(
+                    "refused equal-generation conflicting shard map",
+                    generation=generation,
+                    have=self.shards, got=new, origin=origin,
+                )
+            return False
+        old = list(self.shards)
+        now = self._clock()
+        entries: dict[str, list] = {}
+        for s in new:
+            e = self._entries.get(s)
+            if e is None:
+                kids = [
+                    k for k in self._entries
+                    if k != s and parent_shard(k) == s
+                ]
+                parent = parent_shard(s)
+                if kids:  # merge: inherit the highest-epoch child
+                    e = list(self._entries[max(
+                        kids, key=lambda k: self._entries[k][1]
+                    )])
+                elif parent != s and parent in self._entries:
+                    e = list(self._entries[parent])  # split child
+                else:
+                    e = [s, 0, now]
+            entries[s] = e
+        self._entries = entries
+        self.shards = new
+        self.generation = generation
+        if self.logger is not None:
+            self.logger.info(
+                "shard map generation applied",
+                generation=generation, shards=new,
+                origin=origin or self.node,
+            )
+        for cb in self.on_map_change:
+            try:
+                cb(generation, old, new)
+            except Exception as exc:
+                if self.logger is not None:
+                    self.logger.error(
+                        "shard map-change callback error",
+                        generation=generation, error=str(exc),
+                    )
+        self._publish_gauges()
+        return True
 
     # ------------------------------------------------------------ claims
 
@@ -210,6 +317,8 @@ class ShardDirectory:
                 self.metrics.lease_state.labels(shard=s).set(
                     self.lease_state(s)
                 )
+            if hasattr(self.metrics, "cluster_map_generation"):
+                self.metrics.cluster_map_generation.set(self.generation)
         except Exception:
             pass  # observability must never break routing
 

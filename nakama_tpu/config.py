@@ -517,7 +517,37 @@ OBS_RULE_KEYS = (
     "recompiles_max",
     "stale_after_ms",
     "scenario_burn_1h_max",
+    # Reshard-planner triggers (cluster/reshard.py ReshardPlanner).
+    # 0 = that trigger disabled (the planner still executes
+    # operator-submitted plans).
+    "reshard_skew_max",
+    "reshard_hbm_max_bytes",
+    "reshard_burn_1h_max",
 )
+
+
+@dataclass
+class ReshardConfig:
+    """Elastic shard topology (cluster/reshard.py): the planner on the
+    fleet collector plus the per-owner live-migration state machine.
+    Disabled by default — the static boot-time shard map is unchanged.
+
+    Rule thresholds (pool-size skew, per-owner HBM ledger, SLO burn)
+    ride ``cluster.obs_rules`` under the OBS_RULE_KEYS contract
+    (reshard_skew_max, reshard_hbm_max_bytes, reshard_burn_1h_max)."""
+
+    enabled: bool = False
+    # A migration's tail phase hands over once the un-shipped journal
+    # tail for the moving slice is below this many records (the
+    # drained-below-threshold gate before the epoch+1 claim).
+    drain_threshold_lsn: int = 16
+    # One migration at a time is the rollback-friendly posture: a plan
+    # with several moves executes them serially.
+    max_concurrent_migrations: int = 1
+    # Source-side abort deadline: if the new owner's epoch+1 claim has
+    # not folded back within this budget the plan aborts and the
+    # source keeps its lease (covers a dropped handover frame).
+    handover_timeout_ms: int = 8000
 
 
 @dataclass
@@ -600,6 +630,8 @@ class ClusterConfig:
     # recompiles_max, stale_after_ms, ...). Unknown names are rejected
     # by check() — a typo must not silently disable a rule.
     obs_rules: list[str] = field(default_factory=list)
+    # Elastic shard topology (cluster/reshard.py).
+    reshard: ReshardConfig = field(default_factory=ReshardConfig)
 
 
 @dataclass
@@ -702,10 +734,14 @@ class Config:
                     )
             if shards and cl.role == "device_owner" and (
                 self.name not in shards
-            ):
+            ) and not cl.reshard.enabled:
+                # With resharding enabled an owner outside the boot map
+                # is a RESERVE owner: it owns nothing until a split or
+                # move plan hands it a shard.
                 raise ValueError(
                     "cluster.role is device_owner but this node is not"
-                    " in cluster.shards"
+                    " in cluster.shards (enable cluster.reshard to run"
+                    " a reserve owner)"
                 )
             if cl.standby_of:
                 if cl.standby_of == self.name:
@@ -793,6 +829,27 @@ class Config:
                         f"cluster.obs_rules value {value!r} for"
                         f" {key!r} must be numeric"
                     ) from None
+            rs = cl.reshard
+            if rs.enabled and not shards:
+                raise ValueError(
+                    "cluster.reshard.enabled requires cluster.shards"
+                    " (the elastic map edits the owner-fleet keyspace)"
+                )
+            if rs.drain_threshold_lsn < 1:
+                raise ValueError(
+                    "cluster.reshard.drain_threshold_lsn must be >= 1"
+                )
+            if rs.max_concurrent_migrations != 1:
+                raise ValueError(
+                    "cluster.reshard.max_concurrent_migrations must be"
+                    " 1 (serial migrations are the rollback posture)"
+                )
+            if rs.handover_timeout_ms < cl.heartbeat_ms:
+                raise ValueError(
+                    "cluster.reshard.handover_timeout_ms must be >="
+                    " cluster.heartbeat_ms (the epoch+1 claim folds"
+                    " back on the heartbeat path)"
+                )
         if self.session.encryption_key == "defaultencryptionkey":
             warnings.append("session.encryption_key is the insecure default")
         if self.socket.server_key == "defaultkey":

@@ -92,6 +92,7 @@ class ConsoleServer:
         r.add_get("/v2/console/matchmaker", self._h_matchmaker)
         r.add_get("/v2/console/cluster", self._h_cluster)
         r.add_get("/v2/console/fleet", self._h_fleet)
+        r.add_post("/v2/console/fleet/reshard", self._h_fleet_reshard)
         r.add_get("/v2/console/fleet/traces", self._h_fleet_traces)
         r.add_get(
             "/v2/console/fleet/traces/{trace_id}",
@@ -833,6 +834,31 @@ class ConsoleServer:
         if obs is None:
             return web.json_response({"enabled": False})
         return web.json_response(obs.console_fleet())
+
+    async def _h_fleet_reshard(self, request: web.Request):
+        """Operator-submitted reshard plan (split/merge/move): queued
+        on the collector's planner, executed one migration at a time
+        with the same journal/rollback posture as auto-planned work.
+        Only the collector accepts plans — there is exactly one
+        decision loop per fleet."""
+        self._auth(request, write=True)
+        obs = getattr(self.server, "fleet_obs", None)
+        planner = getattr(obs, "planner", None) if obs is not None else None
+        if planner is None:
+            return _err(
+                400,
+                "reshard planner not running here (needs"
+                " cluster.reshard.enabled and the collector role)",
+            )
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        try:
+            queued = planner.submit(dict(body))
+        except (TypeError, ValueError) as e:
+            return _err(400, f"plan refused: {e}")
+        return web.json_response(queued)
 
     async def _h_fleet_traces(self, request: web.Request):
         """Stitched fleet traces: newest-first summaries from the

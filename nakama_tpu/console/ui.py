@@ -96,6 +96,7 @@ const R = {
   fleet:            ['GET',    '/v2/console/fleet'],
   fleetTraces:      ['GET',    '/v2/console/fleet/traces'],
   fleetTraceGet:    ['GET',    '/v2/console/fleet/traces/{trace_id}'],
+  fleetReshard:     ['POST',   '/v2/console/fleet/reshard'],
   soak:             ['GET',    '/v2/console/soak'],
   device:           ['GET',    '/v2/console/device'],
   deviceCapture:    ['POST',   '/v2/console/device/capture'],
@@ -571,11 +572,21 @@ const TABS = {
       `<tr><td>${esc(a.rule)}</td><td>${esc(a.subject)}</td>
        <td>${esc(a.severity)}</td><td>${esc(a.detail)}</td>
        <td>${esc(a.rounds)}</td></tr>`).join('');
-    const nodes = Object.entries(d.nodes || {}).map(([n, i]) =>
-      `<tr><td>${esc(n)}</td><td>${esc(i.state)}</td>
+    const nodes = Object.entries(d.nodes || {}).map(([n, i]) => {
+      // Per-node shard-map generation + live migration phase: a node
+      // still on an older generation than the collector's is mid-fold
+      // of a reshard; a non-idle phase is a migration in flight.
+      const cl = (i.data || {}).cluster || {};
+      const rs = cl.reshard || {};
+      const mig = rs.phase && rs.phase !== 'idle'
+        ? `${rs.phase} ${(rs.plan || {}).shard || ''}` : '';
+      return `<tr><td>${esc(n)}</td><td>${esc(i.state)}</td>
        <td>${esc(i.stale ? 'STALE' : 'fresh')}</td>
        <td>${esc(i.age_ms)}</td>
-       <td>${esc(i.clock_offset_ms)}</td></tr>`).join('');
+       <td>${esc(i.clock_offset_ms)}</td>
+       <td>${esc(cl.generation != null ? cl.generation : '')}</td>
+       <td>${esc(mig)}</td></tr>`;
+    }).join('');
     const slo = Object.entries(d.slo_merged || {}).map(([n, r]) =>
       `<tr><td>${esc(n)}</td><td>${esc(r.ops)}</td>
        <td>${esc(r.availability)}</td><td>${esc(r.p99_ms)}</td>
@@ -587,14 +598,28 @@ const TABS = {
       <th>detail</th><th>rounds</th></tr>${alerts}</table>
       <h4>nodes</h4>
       <table><tr><th>node</th><th>state</th><th>fresh</th>
-      <th>age ms</th><th>clock off ms</th></tr>${nodes}</table>
+      <th>age ms</th><th>clock off ms</th><th>map gen</th>
+      <th>migration</th></tr>${nodes}</table>
       <h4>merged scenario SLO table</h4>
       <table><tr><th>scenario</th><th>ops</th><th>avail</th>
       <th>p99ms</th><th>burn1h</th><th>interr</th></tr>${slo}</table>
-      <h4>shards</h4>${jpre(d.shards || {})}
+      <h4>shards (map generation ${esc(d.generation || 0)})</h4>
+      ${jpre(d.shards || {})}
+      ${d.reshard ? `<h4>reshard planner</h4>${jpre(d.reshard)}` : ''}
+      <h4>submit reshard plan</h4>
+      <input id="rsplan" size="80" placeholder=
+        '{"kind":"split","shard":"o1/1","shards":["o1/0","o1/1"],"source":"o1","target":"o5"}'>
+      <button id="rsgo">submit</button> <span id="rsout"></span>
       <h4>recent alert events</h4>
       ${jpre((d.alerts || {}).recent_events || [])}
       <div id="ftr"></div><div id="fdet"></div>`));
+    el.querySelector('#rsgo').onclick = report(
+      el.querySelector('#rsout'),
+      async () => {
+        const plan = JSON.parse(el.querySelector('#rsplan').value);
+        const q = await call('fleetReshard', {}, plan);
+        return `queued ${q.queued} (${q.pending} pending)`;
+      });
     const t = await call('fleetTraces', {}, undefined, { n: 50 });
     const rows = (t.traces || []).map(x =>
       `<tr><td><a href="#" data-id="${esc(x.trace_id)}">` +

@@ -66,6 +66,9 @@ FAULT_POINTS = (
     "lease.renew",       # owner lease claim emission (cluster/lease.py)
     "obs.frag",          # trace-fragment export ship (cluster/obs.py), per batch
     "obs.pull",          # collector metrics pull, node-side handler, per pull
+    "reshard.plan",      # planner rule evaluation / plan dispatch (reshard.py)
+    "reshard.migrate",   # source-side snapshot/tail ship, per frame (reshard.py)
+    "reshard.handover",  # the blessing frame to the new owner (reshard.py)
 )
 
 

@@ -509,6 +509,28 @@ class Metrics:
             ("reason",),
         )
 
+        # Elastic resharding plane (cluster/reshard.py): the
+        # generation-versioned shard map plus the live-migration state
+        # machine — an operator watches the generation converge
+        # fleet-wide and the per-phase gauge walk snapshot → tail →
+        # handover → idle on every executed plan.
+        self.cluster_map_generation = gauge(
+            "cluster_map_generation",
+            "Shard-map generation this node routes by (highest "
+            "generation wins fleet-wide; 0 is the boot-time map)",
+        )
+        self.reshard_state = gauge(
+            "reshard_state",
+            "Live-migration state machine, one-hot per phase (1 = the "
+            "local migrator is in that phase)",
+            ("phase",),
+        )
+        self.reshard_migrated_tickets = counter(
+            "reshard_migrated_tickets",
+            "Tickets handed over to a new shard owner by completed "
+            "reshard migrations",
+        )
+
         # Message routing / presence events.
         self.outgoing_dropped = counter(
             "socket_outgoing_dropped", "Messages dropped on full session queues"

@@ -522,19 +522,6 @@ class Checkpointer:
         self._running = True
         t0 = time.perf_counter()
         try:
-            if faults.fire("checkpoint.write"):
-                # drop-mode chaos: this checkpoint round is discarded —
-                # the previous checkpoint + journal tail stay
-                # authoritative, exactly like a failed write.
-                self.logger.warn("checkpoint dropped (fault armed)")
-                if self.metrics is not None:
-                    try:
-                        self.metrics.mm_checkpoints.labels(
-                            outcome="failed"
-                        ).inc()
-                    except Exception:
-                        pass
-                return None
             if self.pre_hook is not None:
                 try:
                     await self.pre_hook()
@@ -547,6 +534,23 @@ class Checkpointer:
             # arriving rows fall at or below the checkpoint LSN, which
             # replay skips.
             await self.journal.flush()
+            if faults.fire("checkpoint.write"):
+                # drop-mode chaos: this checkpoint round is discarded —
+                # the previous checkpoint + journal tail stay
+                # authoritative, exactly like a failed write. The fault
+                # sits AFTER the journal barrier because it models the
+                # snapshot write failing: the flush it barriers on is
+                # real either way, so the surviving journal tail is
+                # durable, not buffered.
+                self.logger.warn("checkpoint dropped (fault armed)")
+                if self.metrics is not None:
+                    try:
+                        self.metrics.mm_checkpoints.labels(
+                            outcome="failed"
+                        ).inc()
+                    except Exception:
+                        pass
+                return None
             # No await between the LSN capture and the snapshot: the
             # pair must be consistent (every op <= lsn reflected, none
             # above it), and both run on the event loop the mutations

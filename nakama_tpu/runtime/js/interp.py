@@ -63,10 +63,13 @@ UNDEFINED = _Undefined()
 
 
 class JSObject:
-    __slots__ = ("props",)
+    __slots__ = ("props", "jsclass")
 
     def __init__(self, props=None):
         self.props = props or {}
+        # The JSClass this object was constructed from (method lookup
+        # falls back to the class chain); plain objects carry None.
+        self.jsclass = None
 
     def get(self, key):
         return self.props.get(key, UNDEFINED)
@@ -83,7 +86,9 @@ class JSArray:
 
 
 class JSFunction:
-    __slots__ = ("name", "params", "body", "env", "is_arrow", "this")
+    __slots__ = (
+        "name", "params", "body", "env", "is_arrow", "this", "home"
+    )
 
     def __init__(self, name, params, body, env, is_arrow, this=UNDEFINED):
         self.name = name or "anonymous"
@@ -92,6 +97,58 @@ class JSFunction:
         self.env = env
         self.is_arrow = is_arrow
         self.this = this  # captured lexically for arrows
+        # The JSClass a method was defined on: `super` resolves from
+        # here (the parent of the DEFINING class, not the instance's —
+        # the ES home-object rule). Plain functions carry None.
+        self.home = None
+
+
+class JSClass:
+    """A `class` declaration's value: constructor + method tables with
+    a parent link. Instances are ordinary JSObjects whose `jsclass`
+    points here — method lookup walks the chain, so there is no
+    per-instance copying and overrides are the nearest-class-wins
+    rule."""
+
+    __slots__ = ("name", "parent", "ctor", "methods", "statics")
+
+    def __init__(self, name, parent=None):
+        self.name = name
+        self.parent = parent
+        self.ctor = None
+        self.methods = {}
+        self.statics = {}
+
+    def find_method(self, name):
+        cls = self
+        while cls is not None:
+            fn = cls.methods.get(name)
+            if fn is not None:
+                return fn
+            cls = cls.parent
+        return None
+
+    def find_static(self, name):
+        cls = self
+        while cls is not None:
+            fn = cls.statics.get(name)
+            if fn is not None:
+                return fn
+            cls = cls.parent
+        return None
+
+
+class JSSuper:
+    """The `super` binding inside a constructor/method: calling it runs
+    the parent constructor chain on the SAME instance; `super.m(...)`
+    resolves `m` on the parent chain and calls it with the original
+    instance as `this`."""
+
+    __slots__ = ("cls", "obj")
+
+    def __init__(self, cls, obj):
+        self.cls = cls  # the parent class of the method's home
+        self.obj = obj  # the instance under construction / receiver
 
 
 class Env:
@@ -332,6 +389,30 @@ class Interp:
                                 self.exec_stmt(stmt, env)
             except _Break:
                 pass
+        elif kind == "classdecl":
+            _, name, parent_node, ctor_node, methods, statics = node
+            parent = None
+            if parent_node is not None:
+                parent = self.eval(parent_node, env)
+                if not isinstance(parent, JSClass):
+                    raise JsRuntimeError(
+                        f"class {name} can only extend another class"
+                    )
+            cls = JSClass(name, parent)
+
+            def mk(fn_node):
+                _, fname, params, body, _arrow = fn_node
+                fn = JSFunction(fname, params, body, env, False)
+                fn.home = cls
+                return fn
+
+            if ctor_node is not None:
+                cls.ctor = mk(ctor_node)
+            for mname, fn_node in methods:
+                cls.methods[mname] = mk(fn_node)
+            for mname, fn_node in statics:
+                cls.statics[mname] = mk(fn_node)
+            env.declare(name, cls)
         elif kind == "empty":
             pass
         else:  # pragma: no cover
@@ -422,6 +503,11 @@ class Interp:
                 args.extend(self._spread_values(self.eval(a[1], env)))
             else:
                 args.append(self.eval(a, env))
+        if isinstance(fn, JSClass):
+            obj = JSObject()
+            obj.jsclass = fn
+            self._construct(fn, args, obj)
+            return obj
         if not isinstance(fn, JSFunction) or fn.is_arrow:
             raise JsRuntimeError("not a constructor")
         obj = JSObject()
@@ -429,6 +515,15 @@ class Interp:
         if isinstance(result, (JSObject, JSArray)):
             return result
         return obj
+
+    def _construct(self, cls, args, obj):
+        """Run the constructor chain: the nearest own constructor (its
+        `super(...)` continues the chain explicitly), or the ES default
+        derived constructor — pass the same args up."""
+        if cls.ctor is not None:
+            self.call_function(cls.ctor, args, this=obj)
+        elif cls.parent is not None:
+            self._construct(cls.parent, args, obj)
 
     def eval_call(self, node, env):
         _, callee, arg_nodes = node
@@ -461,6 +556,15 @@ class Interp:
         raise JsRuntimeError("spread argument is not iterable")
 
     def call_function(self, fn, args, this=UNDEFINED):
+        if isinstance(fn, JSSuper):
+            # `super(...)`: continue the constructor chain on the same
+            # instance.
+            self._construct(fn.cls, args, fn.obj)
+            return UNDEFINED
+        if isinstance(fn, JSClass):
+            raise JsRuntimeError(
+                f"class {fn.name} must be called with new"
+            )
         if isinstance(fn, JSFunction):
             if self.depth >= MAX_DEPTH:
                 raise JsRuntimeError("call depth limit exceeded")
@@ -475,6 +579,8 @@ class Interp:
                 "arguments", JSArray(list(args))
             )
             call_env.declare("this", fn.this if fn.is_arrow else this)
+            if fn.home is not None and fn.home.parent is not None:
+                call_env.declare("super", JSSuper(fn.home.parent, this))
             self.depth += 1
             try:
                 self.exec_stmt(fn.body, call_env)
@@ -504,6 +610,33 @@ class Interp:
     # ------------------------------------------------------ member/index
 
     def get_member(self, obj, name):
+        if isinstance(obj, JSSuper):
+            m = obj.cls.find_method(name)
+            if m is None:
+                raise JsRuntimeError(f"super has no method {name!r}")
+            inst = obj.obj
+            # Bound: `this` inside the parent method is the ORIGINAL
+            # instance, whatever receiver the call site used.
+            return lambda interp, this, *a: interp.call_function(
+                m, list(a), this=inst
+            )
+        if isinstance(obj, JSClass):
+            s = obj.find_static(name)
+            if s is not None:
+                return s
+            if name == "name":
+                return obj.name
+            raise JsRuntimeError(
+                f"class {obj.name} has no static {name!r}"
+            )
+        if (
+            isinstance(obj, JSObject)
+            and obj.jsclass is not None
+            and name not in obj.props
+        ):
+            m = obj.jsclass.find_method(name)
+            if m is not None:
+                return m
         from .stdlib import member_of
 
         return member_of(self, obj, name)
@@ -789,7 +922,7 @@ def _typeof(v) -> str:
         return "number"
     if isinstance(v, str):
         return "string"
-    if isinstance(v, JSFunction) or callable(v):
+    if isinstance(v, (JSFunction, JSClass)) or callable(v):
         return "function"
     return "object"
 

@@ -195,10 +195,58 @@ class Parser:
         if self.at_kw("switch"):
             return self.switch_statement()
         if self.at_kw("class"):
-            self.err("classes are not supported in this subset")
+            return self.class_statement()
         expr = self.expression()
         self.end_statement()
         return ("expr", expr)
+
+    def class_statement(self):
+        """`class Name [extends Parent] { ... }` declarations: methods,
+        `static` methods, one `constructor`. `extends`/`static`/`super`
+        are contextual (they lex as names); the body desugars to a
+        ("classdecl", name, parent_expr, ctor_fn, methods, statics)
+        node the interpreter turns into a JSClass value. Fields and
+        getters/setters stay outside the subset — TS compilers targeting
+        ES6 emit constructor assignments for fields anyway."""
+        self.expect("keyword", "class")
+        name = self.expect("name").value
+        parent = None
+        if self.at("name", "extends"):
+            self.next()
+            parent = self.call_member(self.primary())
+        self.expect("op", "{")
+        ctor = None
+        methods = []  # (name, fn_node) in declaration order
+        statics = []
+        while not self.at_op("}"):
+            if self.at("eof"):
+                self.err("expected '}' closing class body")
+            if self.at_op(";"):
+                self.next()
+                continue
+            static = False
+            if self.at("name", "static") and not (
+                self.peek(1).kind == "op" and self.peek(1).value == "("
+            ):
+                # `static m() {}` — but `static() {}` is a method
+                # literally named "static".
+                self.next()
+                static = True
+            mt = self.next()
+            if mt.kind not in ("name", "str", "keyword"):
+                self.err("expected method name", mt)
+            mname = str(mt.value)
+            fn = self.function_tail(mname)
+            if not static and mname == "constructor":
+                if ctor is not None:
+                    self.err("duplicate constructor", mt)
+                ctor = fn
+            elif static:
+                statics.append((mname, fn))
+            else:
+                methods.append((mname, fn))
+        self.next()
+        return ("classdecl", name, parent, ctor, methods, statics)
 
     def for_statement(self):
         self.expect("keyword", "for")

@@ -251,6 +251,16 @@ class NakamaServer:
                 ingest=self._cluster_ingest,
                 recovery=self.recovery,
             )
+            if (
+                self.cluster.migrator is not None
+                and self._rpc is not None
+            ):
+                # Typed begin/refusal for reshard plans: the planner's
+                # dispatch gets "busy"/"invalid" back instead of a
+                # silently-ignored frame.
+                self._rpc.register(
+                    "reshard.begin", self.cluster.migrator.on_begin
+                )
         # Overload-control plane (overload.py): built here so the API
         # server and pipeline can reference it; signals are registered
         # and the ladder sampler started in start() once the components

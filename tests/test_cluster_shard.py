@@ -27,8 +27,13 @@ from nakama_tpu.cluster import (
     JournalShipper,
     LeaseManager,
     Membership,
+    PlanJournal,
     ReplicationApplier,
+    ReshardPlanner,
     ShardDirectory,
+    ShardMigrator,
+    parent_shard,
+    plan_check,
     rendezvous_shard,
     shard_key,
 )
@@ -849,3 +854,531 @@ def test_no_standby_owner_warm_restarts_to_its_durable_epoch():
     assert plane_d.directory.owner_of("o2") == ("o2", 5)
     assert plane_d.directory.epoch_of("ghost") == 0
     mm_d.stop()
+
+
+# ---------------------------------------------- elastic resharding (PR 14)
+
+
+def test_hierarchical_rendezvous_split_moves_only_parent_keys():
+    """The elastic keyspace contract: splitting one shard into
+    parent/N children redistributes ONLY that shard's keys — every
+    other shard's keyspace is untouched, so a live split never
+    perturbs routing (or migrates tickets) outside the moving slice."""
+    assert parent_shard("o1/0") == "o1"
+    assert parent_shard("o1") == "o1"
+    flat = ["o1", "o2", "o3"]
+    post = ["o2", "o3", "o1/0", "o1/1"]
+    keys = [f"pool-{i}" for i in range(400)]
+    before = {k: rendezvous_shard(k, flat) for k in keys}
+    after = {k: rendezvous_shard(k, post) for k in keys}
+    for k in keys:
+        if before[k] == "o1":
+            # Parent keys land on SOME child of the split parent.
+            assert parent_shard(after[k]) == "o1", k
+        else:
+            assert after[k] == before[k], k  # untouched keyspace
+    # Both children take a share, deterministically across call order.
+    assert {after[k] for k in keys if before[k] == "o1"} == {
+        "o1/0", "o1/1"
+    }
+    assert after == {
+        k: rendezvous_shard(k, list(reversed(post))) for k in keys
+    }
+
+
+def test_apply_map_generation_fencing_and_lease_inheritance():
+    d = ShardDirectory("f", ["o1", "o2"])
+    changes = []
+    d.on_map_change.append(
+        lambda gen, old, new: changes.append((gen, old, new))
+    )
+    assert d.claim("o1", "o1", 2)  # lease history on the parent
+    # Generation 0 is the boot map: a non-increasing edit is refused.
+    assert not d.apply_map(0, ["o1"])
+    assert d.generation == 0 and d.shards == ["o1", "o2"]
+    # Split: the children inherit the parent's owner+epoch (the
+    # source keeps serving until the handover claim at epoch+1).
+    assert d.apply_map(1, ["o2", "o1/0", "o1/1"], origin="plan")
+    assert d.generation == 1
+    assert d.owner_of("o1/0") == ("o1", 2)
+    assert d.owner_of("o1/1") == ("o1", 2)
+    assert d.owner_of("o2") == ("o2", 0)
+    assert changes == [(1, ["o1", "o2"], ["o2", "o1/0", "o1/1"])]
+    # Stale and equal generations are refused, conflicting or not.
+    assert not d.apply_map(1, ["o1", "o2"])
+    assert not d.apply_map(0, ["o1"])
+    assert d.shards == ["o2", "o1/0", "o1/1"]
+    # Takeover on one child, then merge back: the revived parent
+    # inherits its HIGHEST-epoch child entry (never rolls back).
+    assert d.claim("o1/1", "o3", 3)
+    assert d.apply_map(2, ["o1", "o2"], origin="plan")
+    assert d.owner_of("o1") == ("o3", 3)
+    # A brand-new shard id seeds self-owned at epoch 0, like boot.
+    assert d.apply_map(3, ["o1", "o2", "o9"])
+    assert d.owner_of("o9") == ("o9", 0)
+
+
+def test_lease_drops_shards_retired_by_map_edit():
+    """A map edit that retires an owned shard id (split replaced it
+    with children) is NOT a demotion — the lease just stops renewing
+    the retired id instead of claiming outside the keyspace."""
+    d = ShardDirectory("o1", ["o1", "o2"])
+    lease = LeaseManager(d, "o1", ["o1"], LOG)
+    assert lease.heartbeat_payload()["claims"] == [
+        {"shard": "o1", "node": "o1", "epoch": 1}
+    ]
+    d.apply_map(1, ["o2", "o1/0", "o1/1"], origin="plan")
+    assert lease.heartbeat_payload() == {}
+    assert lease.owned == set()
+    assert lease.demotions == 0
+
+
+def test_plan_check_refuses_every_malformed_plan():
+    d = ShardDirectory("o1", ["o1", "o2"])
+    assert d.claim("o1", "o1", 1) and d.claim("o2", "o2", 1)
+
+    def refuses(base, needle, **patch):
+        err = plan_check({**base, **patch}, d, "o1")
+        assert err and needle in err, (patch, err)
+
+    move = dict(
+        plan_id="p", kind="move", shard="o1",
+        shards=["o1", "o2"], source="o1", target="o3",
+    )
+    assert plan_check(dict(move), d, "o1") == ""
+    refuses(move, "missing", plan_id="")
+    refuses(move, "unknown plan kind", kind="explode")
+    assert "not this node" in plan_check(dict(move), d, "o2")
+    refuses(move, "duplicates", shards=["o1", "o2", "o1"])
+    refuses(move, "not in the plan map", shard="zz")
+    refuses(move, "must not edit", shards=["o1"], shard="o1")
+    refuses(move, "target == source", target="o1")
+    refuses(move, "does not own", shard="o2")
+
+    split = dict(
+        plan_id="p", kind="split", shard="o1/1",
+        shards=["o2", "o1/0", "o1/1"], source="o1", target="o3",
+    )
+    assert plan_check(dict(split), d, "o1") == ""
+    refuses(split, "parent/N", shard="o9/1",
+            shards=["o1", "o2", "o9/1"])
+    refuses(split, "own the split parent", shard="o2/1",
+            shards=["o1", "o2/0", "o2/1"])
+    refuses(split, ">= 2 children", shard="o1/0",
+            shards=["o2", "o1/0"])
+    refuses(split, "malformed", shards=["o1/0", "o1/1"])
+    refuses(split, "target == source", target="o1")
+
+    d3 = ShardDirectory("o1", ["o2", "o1/0", "o1/1"])
+    assert d3.claim("o1/0", "o1", 1) and d3.claim("o1/1", "o1", 1)
+    merge = dict(
+        plan_id="p", kind="merge", shard="o1",
+        shards=["o1", "o2"], source="o1", target="o1",
+    )
+    assert plan_check(dict(merge), d3, "o1") == ""
+    assert "parent shard id" in plan_check(
+        {**merge, "shard": "o1/0", "shards": ["o1/0", "o2"]},
+        d3, "o1",
+    )
+    assert "no children" in plan_check(
+        {**merge, "shard": "o9", "shards": ["o9", "o2"]}, d3, "o1"
+    )
+    assert "malformed" in plan_check(
+        {**merge, "shards": ["o1"]}, d3, "o1"
+    )
+    assert d3.claim("o1/1", "o3", 2)
+    assert "every merged child" in plan_check(
+        dict(merge), d3, "o1"
+    )
+
+
+class _BusStub:
+    """Just the migrator's bus surface: handler registry + send log."""
+
+    def __init__(self, node="x"):
+        self.node = node
+        self.handlers = {}
+        self.sent = []
+
+    def on(self, kind, fn):
+        self.handlers[kind] = fn
+
+    def send(self, target, kind, body):
+        self.sent.append((target, kind, body))
+        return True
+
+
+def test_migrator_freeze_fence_and_handover_epochs():
+    d = ShardDirectory("o1", ["o1", "o2"])
+    assert d.claim("o1", "o1", 2)
+    mm = LocalMatchmaker(LOG, _mm_cfg(), node="o1")
+    mig = ShardMigrator("o1", d, None, mm, _BusStub(), None, LOG)
+    assert not mig.is_frozen("anything")
+    post = ["o2", "o1/0", "o1/1"]
+    mig._frozen = ("o1/1", post)
+    # Exactly the keys that rendezvous into the moving slice bounce.
+    for i in range(100):
+        key = f"pool-{i}"
+        assert mig.is_frozen(key) == (
+            rendezvous_shard(key, post) == "o1/1"
+        ), key
+    mig._frozen = None
+    # The epoch the target's claim must exceed: the shard's own entry
+    # for a move, the PARENT's for a split child (the child entry does
+    # not exist at the source yet), the children's max for a merge.
+    assert mig._handover_epoch({"kind": "move", "shard": "o1"}) == 2
+    assert mig._handover_epoch({"kind": "split", "shard": "o1/1"}) == 2
+    d2 = ShardDirectory("o1", ["o2", "o1/0", "o1/1"])
+    assert d2.claim("o1/0", "o1", 4) and d2.claim("o1/1", "o1", 3)
+    mig2 = ShardMigrator("o1", d2, None, mm, _BusStub(), None, LOG)
+    assert mig2._handover_epoch({"kind": "merge", "shard": "o1"}) == 4
+    mm.stop()
+
+
+async def _mk_migration_rig():
+    """Two owners on loopback buses, one shard ("a") owned by o1, o2
+    a reserve; migrators wired, no membership (the test folds the
+    target's map/claims into the source directory by hand, standing in
+    for the heartbeat fold)."""
+    buses = {n: await _mk_bus(n) for n in ("o1", "o2")}
+    await _link(*buses.values())
+    dirs = {
+        n: ShardDirectory(n, ["a"], lease_ms=500, lease_grace_ms=500)
+        for n in buses
+    }
+    for d in dirs.values():
+        assert d.claim("a", "o1", 1)
+    mms = {
+        n: LocalMatchmaker(LOG, _mm_cfg(), node=n) for n in buses
+    }
+    leases = {
+        "o1": LeaseManager(dirs["o1"], "o1", ["a"], LOG),
+        "o2": LeaseManager(dirs["o2"], "o2", [], LOG),
+    }
+    migs = {
+        n: ShardMigrator(
+            n, dirs[n], leases[n], mms[n], buses[n], None, LOG,
+            drain_threshold_lsn=1, handover_timeout_s=5.0,
+        )
+        for n in buses
+    }
+    return buses, dirs, mms, leases, migs
+
+
+async def _migration_rig_down(buses, mms):
+    for mm in mms.values():
+        mm.stop()
+    for b in buses.values():
+        await b.stop()
+
+
+async def test_live_split_migration_end_to_end_zero_loss():
+    """The tentpole protocol on loopback buses: split a->a/0+a/1 with
+    a/1 handed to a reserve owner. Snapshot/tail/handover/confirm run
+    for real; the heartbeat fold is simulated by copying the target's
+    map generation and claims into the source directory. Every ticket
+    in the moving slice lands at the target exactly once, the kept
+    slice never leaves the source, and both leases end correct."""
+    buses, dirs, mms, leases, migs = await _mk_migration_rig()
+    post = ["a/0", "a/1"]
+    by_child = {"a/0": [], "a/1": []}
+    i = 0
+    while min(len(v) for v in by_child.values()) < 3:
+        pool = f"mig-{i}"
+        by_child[rendezvous_shard(pool, post)].append(pool)
+        i += 1
+    pools = by_child["a/0"][:3] + by_child["a/1"][:3]
+    tids = {}
+    for j, pool in enumerate(pools):
+        tid, _ = mms["o1"].add(
+            [MatchmakerPresence(f"u{j}", f"s{j}", node="f")],
+            f"s{j}", "", "*", 2, 2,
+            string_properties={"pool": pool},
+        )
+        tids[tid] = pool
+    moved = {
+        t for t, p in tids.items()
+        if rendezvous_shard(p, post) == "a/1"
+    }
+    kept = set(tids) - moved
+    assert len(moved) == 3 and len(kept) == 3
+
+    plan = {
+        "plan_id": "g1-split-a", "kind": "split", "shard": "a/1",
+        "shards": post, "source": "o1", "target": "o2",
+    }
+    assert plan_check(plan, dirs["o1"], "o1") == ""
+    assert migs["o1"].on_begin("o1", {"plan": plan}) == {
+        "accepted": "g1-split-a"
+    }
+    for _ in range(200):
+        await asyncio.sleep(0.02)
+        # Stand-in for the heartbeat fold: target map + claims -> source.
+        if dirs["o2"].generation > dirs["o1"].generation:
+            dirs["o1"].apply_map(
+                dirs["o2"].generation, list(dirs["o2"].shards),
+                origin="hb",
+            )
+        for s in dirs["o2"].shards:
+            owner, epoch = dirs["o2"].owner_of(s)
+            if owner == "o2":
+                dirs["o1"].claim(s, owner, epoch)
+        if migs["o1"].completed or migs["o1"].aborts:
+            break
+    assert migs["o1"].completed == 1 and migs["o1"].aborts == 0
+    assert migs["o1"].phase == "idle" and migs["o1"]._frozen is None
+    assert migs["o1"].migrated_out == 3
+    assert migs["o2"].migrated_in == 3
+    # Zero loss, no duplicates: the moving slice lives at the target
+    # and ONLY there; the kept slice never left the source.
+    for t in moved:
+        assert mms["o2"].store.get(t) is not None, t
+        assert mms["o1"].store.get(t) is None, t
+    for t in kept:
+        assert mms["o1"].store.get(t) is not None, t
+        assert mms["o2"].store.get(t) is None, t
+    # Map + leases converged: generation 1 everywhere, the source
+    # adopted its retained child, the target owns the moved child at
+    # the fenced epoch+1.
+    assert dirs["o1"].generation == 1 == dirs["o2"].generation
+    assert dirs["o1"].owner_of("a/1") == ("o2", 2)
+    assert dirs["o1"].owner_of("a/0")[0] == "o1"
+    leases["o1"].heartbeat_payload()  # drops the retired parent id
+    assert leases["o1"].owned == {"a/0"}
+    assert leases["o2"].owned == {"a/1"}
+    await _migration_rig_down(buses, mms)
+
+
+async def test_migration_to_dead_target_aborts_with_zero_loss():
+    """A target the bus cannot reach fails the first snapshot frame:
+    the plan aborts before anything is parked — the source keeps its
+    lease, its pool and the boot map, and the migrator returns idle."""
+    buses, dirs, mms, leases, migs = await _mk_migration_rig()
+    tid, _ = mms["o1"].add(
+        [MatchmakerPresence("u1", "s1", node="f")],
+        "s1", "", "*", 2, 2, string_properties={"pool": "mig-0"},
+    )
+    plan = {
+        "plan_id": "g1-split-a", "kind": "split", "shard": "a/1",
+        "shards": ["a/0", "a/1"], "source": "o1", "target": "ghost",
+    }
+    migs["o1"].on_begin("o1", {"plan": plan})
+    for _ in range(100):
+        await asyncio.sleep(0.02)
+        if migs["o1"].aborts:
+            break
+    assert migs["o1"].aborts == 1 and migs["o1"].completed == 0
+    assert migs["o1"].phase == "idle" and migs["o1"]._frozen is None
+    assert mms["o1"].store.get(tid) is not None
+    assert dirs["o1"].generation == 0
+    assert leases["o1"].owned == {"a"}
+    await _migration_rig_down(buses, mms)
+
+
+def test_reshard_regression_gate_units():
+    import bench
+
+    ok = dict(
+        baseline_p99_ms=1000.0,
+        blip_window_ms=0.0,
+        lease_ms=2000,
+        lost_tickets=0,
+        hung=0,
+        generation=2,
+        shards_after=["o1/0", "o1/1", "o2/0", "o2/1"],
+        expected_shards=["o2/0", "o2/1", "o1/0", "o1/1"],
+        migrated_counts={"o3": 5, "o4": 3},
+        plans_executed=2,
+        raised=2,
+        healed=2,
+        active_alerts=0,
+        aborts=0,
+    )
+    reasons, reg = bench.reshard_regression(**ok)
+    assert not reg and not reasons
+    for patch, needle in (
+        (dict(lost_tickets=1), "lost_tickets"),
+        (dict(hung=1), "hung"),
+        (dict(generation=1), "generation"),
+        (dict(shards_after=["o1", "o2"]), "final map"),
+        (dict(migrated_counts={"o3": 5, "o4": 0}), "zero tickets"),
+        (dict(blip_window_ms=4000.0), "blip"),
+        (dict(raised=1), "raised"),
+        (dict(healed=1), "healed"),
+        (dict(active_alerts=1), "never healed"),
+        (dict(aborts=1), "aborts"),
+    ):
+        reasons, reg = bench.reshard_regression(**{**ok, **patch})
+        assert reg and any(needle in r for r in reasons), (
+            patch, reasons,
+        )
+    # An unmeasurable baseline must not trip the blip budget.
+    reasons, reg = bench.reshard_regression(
+        **{**ok, "baseline_p99_ms": 0.0, "blip_window_ms": 9999.0}
+    )
+    assert not reg
+
+
+def _planner_view(counts, reserves=("o5",), hbm=None, burn=None,
+                  stale=()):
+    nodes = {}
+    for n, c in counts.items():
+        nodes[n] = {
+            "stale": n in stale,
+            "data": {
+                "matchmaker_tickets": c,
+                "cluster": {"role": "device_owner"},
+                "devobs": {"memory_total_bytes": (hbm or {}).get(n, 0)},
+            },
+        }
+    for r in reserves:
+        nodes[r] = {
+            "stale": False,
+            "data": {
+                "matchmaker_tickets": 0,
+                "cluster": {"role": "device_owner"},
+            },
+        }
+    return {"nodes": nodes, "slo_merged": burn or {}}
+
+
+def test_planner_auto_plan_triggers():
+    d = ShardDirectory("c", ["o1", "o2"])
+    assert d.claim("o1", "o1", 1) and d.claim("o2", "o2", 1)
+    pl = ReshardPlanner(
+        "c", d, None, LOG, rules={"reshard_skew_max": 1.5}
+    )
+    # Balanced load: no plan.
+    assert pl._auto_plan(_planner_view({"o1": 10, "o2": 10})) is None
+    # Skewed but tiny: below SKEW_MIN_TICKETS skew is noise, not load.
+    assert pl._auto_plan(_planner_view({"o1": 15, "o2": 1})) is None
+    # Real skew: one split of the hot owner's shard toward a reserve.
+    plan = pl._auto_plan(_planner_view({"o1": 30, "o2": 2}))
+    assert plan is not None and plan["kind"] == "split"
+    assert plan["shard"] == "o1/1" and plan["source"] == "o1"
+    assert plan["target"] == "o5"
+    assert set(plan["shards"]) == {"o2", "o1/0", "o1/1"}
+    assert plan["plan_id"] == "g1-split-o1"
+    assert "skew" in plan["reason"]
+    # No reserve owner to grow into: never a plan.
+    assert pl._auto_plan(
+        _planner_view({"o1": 30, "o2": 2}, reserves=())
+    ) is None
+    # A stale hot owner's report is not actionable.
+    assert pl._auto_plan(
+        _planner_view({"o1": 30, "o2": 2}, stale=("o1",))
+    ) is None
+    # HBM pressure trigger (skew quiet).
+    pl2 = ReshardPlanner(
+        "c", d, None, LOG, rules={"reshard_hbm_max_bytes": 1000}
+    )
+    plan2 = pl2._auto_plan(
+        _planner_view({"o1": 1, "o2": 1}, hbm={"o2": 5000})
+    )
+    assert plan2 is not None and plan2["source"] == "o2"
+    assert "hbm" in plan2["reason"]
+    # Merged SLO burn trigger splits the hottest owner.
+    pl3 = ReshardPlanner(
+        "c", d, None, LOG, rules={"reshard_burn_1h_max": 2.0}
+    )
+    plan3 = pl3._auto_plan(_planner_view(
+        {"o1": 5, "o2": 1}, burn={"rpc": {"burn_1h": 3.0}}
+    ))
+    assert plan3 is not None and plan3["source"] == "o1"
+    assert "burn" in plan3["reason"]
+    # One level of elasticity: an already-split owner is left alone.
+    d2 = ShardDirectory("c", ["o2", "o1/0", "o1/1"])
+    assert d2.claim("o1/0", "o1", 1) and d2.claim("o1/1", "o1", 1)
+    assert d2.claim("o2", "o2", 1)
+    pl4 = ReshardPlanner(
+        "c", d2, None, LOG, rules={"reshard_skew_max": 1.5}
+    )
+    assert pl4._auto_plan(_planner_view({"o1": 30, "o2": 2})) is None
+
+
+def test_planner_submit_check_active_and_timeout():
+    clock = [0.0]
+    d = ShardDirectory("c", ["o2", "o1/0", "o1/1"])
+    pl = ReshardPlanner(
+        "c", d, None, LOG, plan_timeout_s=10.0,
+        clock=lambda: clock[0],
+    )
+    with pytest.raises(ValueError):
+        pl.submit({"kind": "split"})
+    out = pl.submit({
+        "kind": "split", "shard": "o1/1",
+        "shards": ["o2", "o1/0", "o1/1"],
+        "source": "o1", "target": "o5",
+    })
+    assert out == {"queued": "g1-split-o1_1", "pending": 1}
+    plan = pl._pending[0]
+    # An active plan completes when the directory shows the target
+    # owning the moved shard...
+    pl.active = {"plan": plan, "at": clock[0]}
+    pl._check_active()
+    assert pl.active is not None  # seeded self-owner: not done yet
+    assert d.claim("o1/1", "o5", 2)
+    pl._check_active()
+    assert pl.active is None and pl.completed == 1
+    # ...and aborts on the plan deadline otherwise.
+    stuck = {**plan, "plan_id": "p2", "target": "o6"}
+    pl.active = {"plan": stuck, "at": clock[0]}
+    clock[0] += 11.0
+    pl._check_active()
+    assert pl.active is None and pl.aborted == 1
+    assert [r["state"] for r in pl.history] == ["done", "aborted"]
+
+
+def test_plan_journal_restart_marks_started_plans_aborted(tmp_path):
+    import json
+
+    path = str(tmp_path / "reshard_plan.json")
+    j = PlanJournal(path, LOG)
+    assert j.recovered_abort is None
+    j.write({"plan": {"plan_id": "p1"}, "state": "started", "t": 0})
+    # A collector restart finds the half-applied plan and journals it
+    # aborted — never replays it.
+    j2 = PlanJournal(path, LOG)
+    assert j2.recovered_abort is not None
+    assert j2.recovered_abort["state"] == "aborted"
+    with open(path) as fh:
+        assert json.load(fh)["state"] == "aborted"
+    # A cleanly finished plan is left alone on the next boot.
+    j2.write({"plan": {"plan_id": "p1"}, "state": "done", "t": 0})
+    assert PlanJournal(path, LOG).recovered_abort is None
+    # The planner surfaces the recovered abort in history/counters.
+    j2.write({"plan": {"plan_id": "p2"}, "state": "started", "t": 0})
+    d = ShardDirectory("c", ["o1"])
+    pl = ReshardPlanner("c", d, None, LOG, journal_path=path)
+    assert pl.aborted == 1
+    assert pl.history[0]["plan"]["plan_id"] == "p2"
+
+
+def test_reshard_active_alert_raises_and_heals():
+    from nakama_tpu.cluster.obs import HealthRuleEngine
+
+    d = ShardDirectory("c", ["o1"])
+    pl = ReshardPlanner("c", d, None, LOG)
+    assert list(pl.conditions()) == []
+    eng = HealthRuleEngine({}, LOG)
+    eng.extra_sources.append(pl.conditions)
+    view = {"nodes": {}}
+    eng.evaluate(view)
+    assert not eng.active
+    pl.active = {
+        "plan": {
+            "plan_id": "g1-split-o1", "kind": "split",
+            "shard": "o1/1", "target": "o5",
+        },
+        "at": 0.0,
+    }
+    eng.evaluate(view)
+    assert ("reshard_active", "g1-split-o1") in eng.active
+    pl.active = None
+    eng.evaluate(view)
+    assert not eng.active
+    events = [
+        e["event"] for e in eng.ledger.recent(16)
+        if e.get("rule") == "reshard_active"
+    ]
+    assert events == ["raised", "healed"]

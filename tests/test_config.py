@@ -179,6 +179,86 @@ def test_cluster_shard_config_check():
         cfg.check()
 
 
+def test_cluster_reshard_config_check():
+    """Elastic-topology knobs: the reshard bounds, the reserve-owner
+    allowance, nested flag loading, and the planner trigger keys in
+    cluster.obs_rules."""
+
+    def base():
+        cfg = Config()
+        cfg.name = "o1"
+        cfg.cluster.enabled = True
+        cfg.cluster.role = "device_owner"
+        cfg.cluster.peers = ["o2=127.0.0.1:7354", "o3=127.0.0.1:7355"]
+        cfg.cluster.shards = ["o1", "o2"]
+        return cfg
+
+    # Defaults: disabled, serial migrations, sane budgets.
+    cfg = Config()
+    assert cfg.cluster.reshard.enabled is False
+    assert cfg.cluster.reshard.drain_threshold_lsn == 16
+    assert cfg.cluster.reshard.max_concurrent_migrations == 1
+    assert cfg.cluster.reshard.handover_timeout_ms == 8000
+    base().check()
+    cfg = base()
+    cfg.cluster.reshard.enabled = True
+    cfg.check()
+    # Enabled resharding needs a shard map to edit.
+    cfg = base()
+    cfg.cluster.shards = []
+    cfg.cluster.reshard.enabled = True
+    with pytest.raises(ValueError, match="requires cluster.shards"):
+        cfg.check()
+    # Bounds: drain >= 1, serial-only migrations, a handover budget
+    # the heartbeat fold can actually meet.
+    cfg = base()
+    cfg.cluster.reshard.drain_threshold_lsn = 0
+    with pytest.raises(ValueError, match="drain_threshold_lsn"):
+        cfg.check()
+    cfg = base()
+    cfg.cluster.reshard.max_concurrent_migrations = 2
+    with pytest.raises(ValueError, match="max_concurrent_migrations"):
+        cfg.check()
+    cfg = base()
+    cfg.cluster.reshard.handover_timeout_ms = (
+        cfg.cluster.heartbeat_ms - 1
+    )
+    with pytest.raises(ValueError, match="handover_timeout_ms"):
+        cfg.check()
+    # A reserve owner (outside the boot map) is only legal when the
+    # elastic topology can hand it a shard.
+    cfg = base()
+    cfg.name = "o3"
+    cfg.cluster.peers = ["o1=127.0.0.1:7353", "o2=127.0.0.1:7354"]
+    with pytest.raises(ValueError, match="reserve"):
+        cfg.check()
+    cfg.cluster.reshard.enabled = True
+    cfg.check()
+    # The planner trigger thresholds ride cluster.obs_rules.
+    cfg = base()
+    cfg.cluster.obs_rules = [
+        "reshard_skew_max=1.5",
+        "reshard_hbm_max_bytes=2e9",
+        "reshard_burn_1h_max=6",
+    ]
+    cfg.check()
+    cfg.cluster.obs_rules = ["reshard_skew_max=hot"]
+    with pytest.raises(ValueError, match="numeric"):
+        cfg.check()
+    cfg.cluster.obs_rules = ["reshard_bogus=1"]
+    with pytest.raises(ValueError, match="reshard_skew_max"):
+        cfg.check()
+    # The section loads through the nested flag path.
+    cfg = load_config([], [
+        "--cluster.reshard.enabled", "true",
+        "--cluster.reshard.drain_threshold_lsn", "32",
+        "--cluster.reshard.handover_timeout_ms=4000",
+    ])
+    assert cfg.cluster.reshard.enabled is True
+    assert cfg.cluster.reshard.drain_threshold_lsn == 32
+    assert cfg.cluster.reshard.handover_timeout_ms == 4000
+
+
 def test_parallel_defaults_off():
     cfg = Config()
     assert cfg.parallel.enabled is False

@@ -1675,3 +1675,236 @@ async def test_obs_pull_raise_keeps_last_known_flags_stale_never_wedges():
         faults.disarm()
         rig["mm"].stop()
         await _obs_rig_down(rig)
+
+
+# ---------------------------------------------- elastic reshard chaos legs
+
+
+async def _reshard_rig():
+    """Two owners on loopback buses: shard "a" owned by o1, o2 the
+    reserve target — the smallest rig reshard.migrate /
+    reshard.handover fire on. Six tickets pool on the source; the
+    split plan moves the a/1 share of them."""
+    from nakama_tpu.cluster import (
+        ClusterBus,
+        LeaseManager,
+        ShardDirectory,
+        ShardMigrator,
+    )
+
+    log = quiet_logger()
+    cfg = MatchmakerConfig(backend="cpu", pool_capacity=64,
+                           max_tickets=64)
+    buses = {}
+    for n in ("o1", "o2"):
+        bus = ClusterBus(n, "127.0.0.1:0", {}, log)
+        await bus.start()
+        buses[n] = bus
+    for a in buses.values():
+        for b in buses.values():
+            if a is not b:
+                a.add_peer(b.node, f"127.0.0.1:{b.port}")
+    dirs = {n: ShardDirectory(n, ["a"]) for n in buses}
+    for d in dirs.values():
+        assert d.claim("a", "o1", 1)
+    mms = {n: LocalMatchmaker(log, cfg, node=n) for n in buses}
+    leases = {
+        "o1": LeaseManager(dirs["o1"], "o1", ["a"], log),
+        "o2": LeaseManager(dirs["o2"], "o2", [], log),
+    }
+    migs = {
+        n: ShardMigrator(
+            n, dirs[n], leases[n], mms[n], buses[n], None, log,
+            drain_threshold_lsn=1, handover_timeout_s=0.5,
+        )
+        for n in buses
+    }
+    tids = []
+    for i in range(6):
+        tid, _ = mms["o1"].add(
+            [MatchmakerPresence(f"u{i}", f"s{i}", node="f")],
+            f"s{i}", "", "*", 2, 2,
+            string_properties={"pool": f"mig-{i}"},
+        )
+        tids.append(tid)
+    plan = {
+        "plan_id": "g1-split-a", "kind": "split", "shard": "a/1",
+        "shards": ["a/0", "a/1"], "source": "o1", "target": "o2",
+    }
+    return buses, dirs, mms, leases, migs, tids, plan
+
+
+async def _reshard_rig_down(buses, mms):
+    for mm in mms.values():
+        mm.stop()
+    for b in buses.values():
+        await b.stop()
+
+
+async def test_reshard_migrate_drop_seq_gap_refuses_handover_aborts():
+    """Drop-mode reshard.migrate loses migration frames IN FLIGHT (the
+    source doesn't know): the target's seq tracking sees the gap and
+    REFUSES the blessing, so the source times out and aborts — the
+    parked slice re-inserts at the source, zero tickets lost, the map
+    and leases untouched."""
+    buses, dirs, mms, leases, migs, tids, plan = await _reshard_rig()
+    try:
+        faults.arm("reshard.migrate", "drop", probability=1.0)
+        migs["o1"].on_begin("o1", {"plan": plan})
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if migs["o1"].aborts:
+                break
+        assert faults.PLANE.fired.get("reshard.migrate", 0) > 0
+        assert migs["o1"].aborts == 1 and migs["o1"].completed == 0
+        assert migs["o2"].refused_handovers == 1
+        assert migs["o2"].migrated_in == 0 and len(mms["o2"]) == 0
+        # Zero loss: every ticket is back in the source pool.
+        for t in tids:
+            assert mms["o1"].store.get(t) is not None, t
+        # Nothing moved: boot map, boot lease, the fence lifted.
+        assert dirs["o1"].generation == 0 == dirs["o2"].generation
+        assert leases["o1"].owned == {"a"}
+        assert migs["o1"].phase == "idle"
+        assert migs["o1"]._frozen is None
+        assert not migs["o2"]._staging
+    finally:
+        faults.disarm()
+        await _reshard_rig_down(buses, mms)
+
+
+async def test_reshard_handover_drop_staged_never_live_clean_abort():
+    """Drop-mode reshard.handover loses the blessing itself: the
+    target's staging is COMPLETE but staged tickets must never reach
+    its live pool without the blessing. The source aborts on the
+    confirm timeout, re-inserts the parked slice, and its abort frame
+    makes the target discard the staging."""
+    from nakama_tpu.cluster import rendezvous_shard
+
+    buses, dirs, mms, leases, migs, tids, plan = await _reshard_rig()
+    try:
+        moving = [
+            t for i, t in enumerate(tids)
+            if rendezvous_shard(f"mig-{i}", plan["shards"]) == "a/1"
+        ]
+        assert moving  # the leg must exercise a real parked slice
+        faults.arm("reshard.handover", "drop", probability=1.0)
+        migs["o1"].on_begin("o1", {"plan": plan})
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if migs["o1"].aborts:
+                break
+        assert faults.PLANE.fired.get("reshard.handover", 0) == 1
+        assert migs["o1"].aborts == 1 and migs["o1"].completed == 0
+        assert migs["o2"].migrated_in == 0  # staged, never blessed
+        assert migs["o2"].refused_handovers == 0
+        assert not migs["o2"]._staging  # the abort discarded it
+        assert len(mms["o2"]) == 0
+        for t in tids:
+            assert mms["o1"].store.get(t) is not None, t
+        assert dirs["o2"].generation == 0  # map edit never applied
+        assert leases["o1"].owned == {"a"}
+        assert leases["o2"].owned == set()
+        assert migs["o1"]._frozen is None
+    finally:
+        faults.disarm()
+        await _reshard_rig_down(buses, mms)
+
+
+async def test_reshard_dead_source_staging_inert_ttl_swept_no_replay():
+    """The SIGKILL-mid-migration story, in process: a source that dies
+    after shipping its snapshot leaves the target holding staged
+    tickets. They must NEVER reach the live pool (no blessing arrived),
+    the staging TTL sweeps them, and a late replayed blessing after
+    the sweep is refused — no double-delivery path exists."""
+    from nakama_tpu.cluster import ShardDirectory, ShardMigrator
+    from nakama_tpu.cluster.replication import extract_to_payload
+    from nakama_tpu.cluster.reshard import STAGING_TTL_S
+
+    log = quiet_logger()
+    cfg = MatchmakerConfig(backend="cpu", pool_capacity=64,
+                           max_tickets=64)
+    src = LocalMatchmaker(log, cfg, node="o1")
+    for i in range(4):
+        src.add(
+            [MatchmakerPresence(f"u{i}", f"s{i}", node="f")],
+            f"s{i}", "", "*", 2, 2,
+            string_properties={"pool": f"mig-{i}"},
+        )
+    payloads = [extract_to_payload(ex) for ex in src.extract()]
+
+    class _Bus:
+        node = "o2"
+
+        def on(self, kind, fn):
+            pass
+
+        def send(self, target, kind, body):
+            return True
+
+    d = ShardDirectory("o2", ["a"])
+    tgt = LocalMatchmaker(log, cfg, node="o2")
+    mig = ShardMigrator("o2", d, None, tgt, _Bus(), None, log)
+    mig._on_snap("o1", {
+        "plan_id": "p1", "shard": "a/1", "seq": 0, "n": 1,
+        "tickets": payloads,
+    })
+    assert len(mig._staging["p1"]["tickets"]) == 4
+    assert len(tgt) == 0  # staged tickets never live without blessing
+    # The source is gone: no handover, no abort. The TTL sweeps it.
+    mig._staging["p1"]["at"] -= STAGING_TTL_S + 1
+    mig._gc_staging()
+    assert not mig._staging
+    # A late replayed blessing after the sweep must not deliver.
+    mig._on_handover("o1", {
+        "plan_id": "p1", "kind": "split", "shard": "a/1", "gen": 1,
+        "shards": ["a/0", "a/1"], "epoch": 1, "final": [],
+        "removed": [], "total": 4,
+    })
+    assert len(tgt) == 0 and mig.migrated_in == 0
+    assert mig.refused_handovers == 1
+    assert d.generation == 0  # the map edit never applied either
+    src.stop()
+    tgt.stop()
+
+
+async def test_reshard_plan_fault_costs_the_round_never_the_planner():
+    """Armed reshard.plan: drop mode skips one planner round (the
+    queued plan stays queued), raise mode surfaces to the collector's
+    guard BEFORE any planner state mutates. Disarmed, the queued plan
+    dispatches on the next round."""
+    from nakama_tpu.cluster import ReshardPlanner, ShardDirectory
+
+    log = quiet_logger()
+    d = ShardDirectory("c", ["o1", "o2"])
+
+    class _Rpc:
+        def __init__(self):
+            self.calls = []
+
+        async def call(self, node, kind, body):
+            self.calls.append((node, kind))
+            return {"accepted": "x"}
+
+    rpc = _Rpc()
+    pl = ReshardPlanner("c", d, rpc, log)
+    pl.submit({
+        "kind": "split", "shard": "o1/1",
+        "shards": ["o2", "o1/0", "o1/1"],
+        "source": "o1", "target": "o5",
+    })
+    view = {"nodes": {}}
+    faults.arm("reshard.plan", "drop", probability=1.0)
+    await pl.tick(view)
+    assert not rpc.calls and pl.active is None
+    assert len(pl._pending) == 1
+    faults.disarm("reshard.plan")
+    faults.arm("reshard.plan", "raise", probability=1.0)
+    with pytest.raises(InjectedFault):
+        await pl.tick(view)
+    assert not rpc.calls and pl.active is None
+    assert len(pl._pending) == 1
+    faults.disarm("reshard.plan")
+    await pl.tick(view)
+    assert rpc.calls == [("o1", "reshard.begin")]
+    assert pl.active is not None and pl.dispatched == 1

@@ -180,6 +180,9 @@ GOLDEN_EXPOSITION = {
     ("nakama_owner_takeovers", "Counter", ("reason",)),
     ("nakama_replication_lag_lsn", "Gauge", ()),
     ("nakama_replication_lag_sec", "Gauge", ()),
+    ("nakama_cluster_map_generation", "Gauge", ()),
+    ("nakama_reshard_state", "Gauge", ("phase",)),
+    ("nakama_reshard_migrated_tickets", "Counter", ()),
     ("nakama_db_write_batch_size", "Histogram", ()),
     ("nakama_db_write_queue_depth", "Gauge", ()),
     ("nakama_device_kernel_time_sec", "Histogram", ("kernel",)),
